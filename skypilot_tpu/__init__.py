@@ -1,0 +1,31 @@
+"""skypilot_tpu: a TPU-native orchestration + training/serving framework.
+
+Capability surface of SkyPilot (reference at /root/reference), re-designed
+TPU-first: Task YAML -> cost optimizer over a TPU catalog -> TPU-VM/pod-slice
+provisioner with zone failover -> SSH gang executor with a jax.distributed
+rendezvous contract (no Ray) -> managed jobs / serving / storage on top, and
+an in-repo JAX compute path (models, pallas ops, SPMD parallelism) for the
+workloads the reference delegates to user frameworks.
+"""
+__version__ = '0.1.0'
+
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.tpu_topology import TpuTopology, parse_tpu_type
+
+
+def __getattr__(name):
+    """Lazy entry points so `import skypilot_tpu` stays fast and partial
+    builds remain importable."""
+    if name == 'optimize':
+        from skypilot_tpu import optimizer
+        return optimizer.optimize
+    if name in ('launch', 'exec'):
+        from skypilot_tpu import execution
+        return getattr(execution, name)
+    if name in ('status', 'start', 'stop', 'down', 'autostop', 'queue',
+                'cancel', 'tail_logs', 'cost_report'):
+        from skypilot_tpu import core
+        return getattr(core, name)
+    raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -1,0 +1,176 @@
+"""Service catalog: TPU + GCE offerings with pricing.
+
+Reference equivalent: sky/clouds/service_catalog/ (7115 LoC, pandas over
+hosted CSVs). We load two small curated CSVs (see fetcher.py) into plain
+dataclass indexes — no pandas needed at runtime, lookups are O(1) dict hits.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import pathlib
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_topology
+
+_DATA_DIR = pathlib.Path(__file__).parent / 'data'
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuOffering:
+    """One (TPU type, zone) row: a launchable slice with its price."""
+    topology: tpu_topology.TpuTopology
+    region: str
+    zone: str
+    price_hr: float
+    spot_price_hr: float
+    host_vcpus: int
+    host_memory_gb: float
+
+    def price(self, use_spot: bool) -> float:
+        return self.spot_price_hr if use_spot else self.price_hr
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceOffering:
+    """One (GCE instance type, zone) row for controllers / CPU tasks."""
+    instance_type: str
+    vcpus: int
+    memory_gb: float
+    region: str
+    zone: str
+    price_hr: float
+    spot_price_hr: float
+
+    def price(self, use_spot: bool) -> float:
+        return self.spot_price_hr if use_spot else self.price_hr
+
+
+def _ensure_csvs() -> None:
+    if not (_DATA_DIR / 'tpu_catalog.csv').exists():
+        from skypilot_tpu.catalog import fetcher
+        fetcher.generate_tpu_csv(_DATA_DIR / 'tpu_catalog.csv')
+        fetcher.generate_gce_csv(_DATA_DIR / 'gce_catalog.csv')
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_index() -> Dict[str, List[TpuOffering]]:
+    _ensure_csvs()
+    index: Dict[str, List[TpuOffering]] = {}
+    with open(_DATA_DIR / 'tpu_catalog.csv') as f:
+        for row in csv.DictReader(f):
+            topo = tpu_topology.TpuTopology(
+                type_name=row['tpu_type'], generation=row['generation'],
+                num_chips=int(row['num_chips']),
+                num_hosts=int(row['num_hosts']),
+                chips_per_host=int(row['chips_per_host']))
+            off = TpuOffering(
+                topology=topo, region=row['region'], zone=row['zone'],
+                price_hr=float(row['price_hr']),
+                spot_price_hr=float(row['spot_price_hr']),
+                host_vcpus=int(row['host_vcpus']),
+                host_memory_gb=float(row['host_memory_gb']))
+            index.setdefault(topo.type_name, []).append(off)
+    return index
+
+
+@functools.lru_cache(maxsize=1)
+def _gce_index() -> Dict[str, List[InstanceOffering]]:
+    _ensure_csvs()
+    index: Dict[str, List[InstanceOffering]] = {}
+    with open(_DATA_DIR / 'gce_catalog.csv') as f:
+        for row in csv.DictReader(f):
+            off = InstanceOffering(
+                instance_type=row['instance_type'], vcpus=int(row['vcpus']),
+                memory_gb=float(row['memory_gb']), region=row['region'],
+                zone=row['zone'], price_hr=float(row['price_hr']),
+                spot_price_hr=float(row['spot_price_hr']))
+            index.setdefault(off.instance_type, []).append(off)
+    return index
+
+
+def list_tpu_types() -> List[str]:
+    return sorted(_tpu_index().keys(),
+                  key=lambda t: (t.rsplit('-', 1)[0],
+                                 int(t.rsplit('-', 1)[1])))
+
+
+def list_instance_types() -> List[str]:
+    return sorted(_gce_index().keys())
+
+
+def get_tpu_offerings(
+        tpu_type: str,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> List[TpuOffering]:
+    """All zones offering `tpu_type`, optionally filtered; sorted by price.
+
+    `tpu_type` accepts any spelling parse_tpu_type accepts.
+    """
+    topo = tpu_topology.parse_tpu_type(tpu_type)
+    offs = _tpu_index().get(topo.type_name, [])
+    if region is not None:
+        offs = [o for o in offs if o.region == region]
+    if zone is not None:
+        offs = [o for o in offs if o.zone == zone]
+    return sorted(offs, key=lambda o: o.price_hr)
+
+
+def get_instance_offerings(
+        instance_type: str,
+        region: Optional[str] = None,
+        zone: Optional[str] = None) -> List[InstanceOffering]:
+    offs = _gce_index().get(instance_type, [])
+    if region is not None:
+        offs = [o for o in offs if o.region == region]
+    if zone is not None:
+        offs = [o for o in offs if o.zone == zone]
+    return sorted(offs, key=lambda o: o.price_hr)
+
+
+def cheapest_instance_by_shape(
+        min_vcpus: float = 0, min_memory_gb: float = 0,
+        region: Optional[str] = None) -> Optional[str]:
+    """Pick the cheapest instance type meeting a cpu/mem floor (used for
+    controller sizing; reference: controller_utils.py:438)."""
+    best: Optional[Tuple[float, str]] = None
+    for name, offs in _gce_index().items():
+        for off in offs:
+            if region is not None and off.region != region:
+                continue
+            if off.vcpus >= min_vcpus and off.memory_gb >= min_memory_gb:
+                if best is None or off.price_hr < best[0]:
+                    best = (off.price_hr, name)
+                break
+    return best[1] if best else None
+
+
+def list_accelerators(name_filter: Optional[str] = None
+                      ) -> Dict[str, List[TpuOffering]]:
+    """`sky show-gpus` backing call (reference:
+    service_catalog/__init__.py:60). TPU-only by design."""
+    out = {}
+    for name, offs in _tpu_index().items():
+        if name_filter is None or name_filter.lower() in name.lower():
+            out[name] = sorted(offs, key=lambda o: o.price_hr)
+    return out
+
+
+def validate_region_zone(region: Optional[str],
+                         zone: Optional[str]) -> None:
+    """Check region/zone strings exist somewhere in the catalog."""
+    known_zones = {o.zone for offs in _tpu_index().values() for o in offs}
+    known_zones |= {o.zone for offs in _gce_index().values() for o in offs}
+    known_regions = {z.rsplit('-', 1)[0] for z in known_zones}
+    if region is not None and region not in known_regions:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown region {region!r}. Known: {sorted(known_regions)}')
+    if zone is not None and zone not in known_zones:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown zone {zone!r}.')
+    if region is not None and zone is not None:
+        if not zone.startswith(region):
+            raise exceptions.InvalidResourcesError(
+                f'Zone {zone!r} is not in region {region!r}.')

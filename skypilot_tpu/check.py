@@ -1,0 +1,62 @@
+"""`skyt check`: probe cloud credentials, cache enabled clouds.
+
+Reference: sky/check.py (254 LoC) — probes each registered cloud's
+check_credentials() and stores the result in global_user_state.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_ENABLED_CLOUDS_KEY = 'enabled_clouds'
+
+
+def _check_gcp() -> Tuple[bool, Optional[str]]:
+    """GCP is enabled iff application-default credentials + project exist."""
+    try:
+        import google.auth  # type: ignore
+        creds, project = google.auth.default()
+        if project is None:
+            return False, 'No default GCP project set.'
+        return True, None
+    except Exception as e:  # pylint: disable=broad-except
+        return False, f'GCP credentials not found: {e}'
+
+
+def _check_fake() -> Tuple[bool, Optional[str]]:
+    """The fake (localhost) cloud is always available; it is only *enabled*
+    when explicitly requested (tests set SKYT_ENABLE_FAKE_CLOUD=1) so real
+    users never accidentally "launch" onto their own machine."""
+    import os
+    if os.environ.get('SKYT_ENABLE_FAKE_CLOUD') == '1':
+        return True, None
+    return False, 'Set SKYT_ENABLE_FAKE_CLOUD=1 to enable.'
+
+
+_CHECKS = {'gcp': _check_gcp, 'fake': _check_fake}
+
+
+def check(quiet: bool = False) -> List[str]:
+    """Probe all clouds; persist + return the enabled list."""
+    enabled = []
+    for cloud, fn in _CHECKS.items():
+        ok, reason = fn()
+        if ok:
+            enabled.append(cloud)
+            if not quiet:
+                print(f'  \x1b[32m✓\x1b[0m {cloud}')
+        elif not quiet:
+            print(f'  \x1b[90m✗ {cloud}: {reason}\x1b[0m')
+    global_user_state.set_config_value(_ENABLED_CLOUDS_KEY, enabled)
+    return enabled
+
+
+def get_cached_enabled_clouds() -> List[str]:
+    cached = global_user_state.get_config_value(_ENABLED_CLOUDS_KEY)
+    if cached is None:
+        cached = check(quiet=True)
+    return cached

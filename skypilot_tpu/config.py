@@ -1,0 +1,75 @@
+"""Paths + user config (reference: sky/skypilot_config.py, 259 LoC).
+
+All client-side state lives under SKYT_HOME (default ~/.skyt), overridable
+via env so tests get hermetic state dirs:
+    state.db            client state (clusters, enabled clouds, history)
+    config.yaml         user config (nested keys via get_nested)
+    generated/          rendered cluster configs
+    logs/               per-launch client logs
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pathlib
+import threading
+from typing import Any, List, Optional
+
+import yaml
+
+_lock = threading.Lock()
+_config_cache: Optional[dict] = None
+_config_cache_path: Optional[str] = None
+
+
+def home_dir() -> pathlib.Path:
+    d = pathlib.Path(os.environ.get('SKYT_HOME', '~/.skyt')).expanduser()
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def state_db_path() -> str:
+    return str(home_dir() / 'state.db')
+
+
+def generated_dir() -> pathlib.Path:
+    d = home_dir() / 'generated'
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def logs_dir() -> pathlib.Path:
+    d = home_dir() / 'logs'
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _load_config() -> dict:
+    global _config_cache, _config_cache_path
+    path = str(home_dir() / 'config.yaml')
+    with _lock:
+        if _config_cache is not None and _config_cache_path == path:
+            return _config_cache
+        cfg = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                cfg = yaml.safe_load(f) or {}
+        _config_cache = cfg
+        _config_cache_path = path
+        return cfg
+
+
+def reload() -> None:
+    global _config_cache
+    with _lock:
+        _config_cache = None
+
+
+def get_nested(keys: List[str], default: Any = None) -> Any:
+    """config.yaml nested lookup, e.g. get_nested(['gcp', 'project_id'])."""
+    node: Any = _load_config()
+    for k in keys:
+        if not isinstance(node, dict) or k not in node:
+            return default
+        node = node[k]
+    return node

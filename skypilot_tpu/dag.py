@@ -1,0 +1,78 @@
+"""Dag: an ordered container of Tasks (reference: sky/dag.py, 106 LoC).
+
+The reference stores a networkx digraph but only chains are supported in
+practice (execution.py:180 asserts a single task). We store an explicit list
+of tasks with implicit chain edges — the optimizer's DP handles chains
+directly, and managed jobs execute tasks sequentially.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from skypilot_tpu.task import Task
+
+
+class Dag:
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+        self.tasks: List[Task] = []
+
+    def add(self, task: Task) -> None:
+        self.tasks.append(task)
+
+    def remove(self, task: Task) -> None:
+        self.tasks.remove(task)
+
+    @property
+    def is_chain(self) -> bool:
+        return True  # by construction
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __iter__(self):
+        return iter(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        return f'Dag({self.name}, {len(self.tasks)} tasks)'
+
+
+class _DagContext(threading.local):
+    """Thread-local `with Dag():` context (reference: dag.py:80)."""
+
+    def __init__(self):
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_context = _DagContext()
+push_dag = _context.push
+pop_dag = _context.pop
+get_current_dag = _context.current
+
+
+def to_dag(task_or_dag) -> Dag:
+    """Wrap a bare Task into a single-node Dag (reference:
+    dag_utils.convert_entrypoint_to_dag)."""
+    if isinstance(task_or_dag, Dag):
+        return task_or_dag
+    dag = Dag(name=getattr(task_or_dag, 'name', None))
+    dag.add(task_or_dag)
+    return dag

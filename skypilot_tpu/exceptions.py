@@ -1,0 +1,136 @@
+"""Typed failure taxonomy for the TPU-native framework.
+
+Mirrors the capability surface of the reference's `sky/exceptions.py` (316
+LoC): provisioning failures carry enough structure for the failover engine to
+blocklist at the right granularity (zone / region / cloud), instead of
+re-parsing error strings at every layer.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+
+class FailoverScope(enum.Enum):
+    """Granularity at which a provisioning failure should blocklist."""
+    ZONE = 'zone'
+    REGION = 'region'
+    CLOUD = 'cloud'
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NotSupportedError(SkyTpuError):
+    """Requested operation is unsupported (e.g. stopping a TPU pod slice)."""
+
+
+class InvalidTaskError(SkyTpuError):
+    """Task YAML / Task object failed validation."""
+
+
+class InvalidResourcesError(SkyTpuError):
+    """Resources spec failed validation (unknown accelerator, bad topology)."""
+
+
+class ResourcesUnavailableError(SkyTpuError):
+    """No feasible resources; carries failover history for diagnostics.
+
+    Reference behavior: sky/exceptions.py ResourcesUnavailableError with
+    `failover_history`.
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None,
+                 no_failover: bool = False) -> None:
+        super().__init__(message)
+        self.failover_history = failover_history or []
+        self.no_failover = no_failover
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Task demands don't fit the cluster it was asked to run on."""
+
+
+class ProvisionError(SkyTpuError):
+    """A single provisioning attempt failed.
+
+    `scope` tells RetryingProvisioner how widely to blocklist; the reference
+    derives this by scraping provider stdout (FailoverCloudErrorHandlerV1/V2,
+    cloud_vm_ray_backend.py:729-1155) — we carry it as structure instead.
+    """
+
+    def __init__(self, message: str,
+                 scope: FailoverScope = FailoverScope.ZONE,
+                 retryable: bool = True) -> None:
+        super().__init__(message)
+        self.scope = scope
+        self.retryable = retryable
+
+
+class TpuCapacityError(ProvisionError):
+    """TPU stockout in a zone — the common case for pods."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, scope=FailoverScope.ZONE)
+
+
+class QuotaExceededError(ProvisionError):
+    """Quota errors blocklist the whole region (can't be fixed by re-trying)."""
+
+    def __init__(self, message: str) -> None:
+        super().__init__(message, scope=FailoverScope.REGION, retryable=False)
+
+
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in the state DB."""
+
+
+class InvalidClusterNameError(SkyTpuError):
+    """Cluster name fails the (cloud-specific) naming rules."""
+
+
+class CommandError(SkyTpuError):
+    """A remote command exited nonzero.
+
+    Reference: sky/exceptions.py CommandError(returncode, command, reason).
+    """
+
+    def __init__(self, returncode: int, command: str, error_msg: str = '',
+                 detailed_reason: str = '') -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        msg = (f'Command {command[:100]!r} failed with return code '
+               f'{returncode}. {error_msg}')
+        super().__init__(msg)
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the on-cluster job queue."""
+
+
+class StorageError(SkyTpuError):
+    """Bucket lifecycle / sync failures."""
+
+
+class StorageSpecError(StorageError):
+    """Bad storage spec in task YAML."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down while an operation was in flight."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job exhausted max_restarts_on_errors."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud credentials found for any enabled cloud."""

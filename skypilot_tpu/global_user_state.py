@@ -1,0 +1,266 @@
+"""Client-side state DB (reference: sky/global_user_state.py, 841 LoC).
+
+SQLite at `config.state_db_path()`. Tables:
+  clusters         name -> pickled handle + status + autostop + usage times
+  cluster_history  usage intervals for `skyt cost-report`
+  config           key/value (enabled clouds cache, etc.)
+  storage          tracked buckets
+"""
+from __future__ import annotations
+
+import enum
+import json
+import pickle
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+
+_local = threading.local()
+
+
+class ClusterStatus(enum.Enum):
+    """Reference: sky/utils/status_lib.py ClusterStatus + the state machine
+    in sky/design_docs/cluster_status.md."""
+    INIT = 'INIT'
+    UP = 'UP'
+    STOPPED = 'STOPPED'
+
+    def colored(self) -> str:
+        color = {'INIT': '\x1b[33m', 'UP': '\x1b[32m',
+                 'STOPPED': '\x1b[90m'}[self.value]
+        return f'{color}{self.value}\x1b[0m'
+
+
+def _conn() -> sqlite3.Connection:
+    path = config_lib.state_db_path()
+    cached = getattr(_local, 'conns', None)
+    if cached is None:
+        _local.conns = cached = {}
+    if path not in cached:
+        conn = sqlite3.connect(path)
+        conn.execute('PRAGMA journal_mode=WAL')
+        _create_tables(conn)
+        cached[path] = conn
+    return cached[path]
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at REAL,
+            handle BLOB,
+            last_use TEXT,
+            status TEXT,
+            autostop INTEGER DEFAULT -1,
+            to_down INTEGER DEFAULT 0,
+            last_activity REAL,
+            config_hash TEXT);
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            cluster_name TEXT,
+            usage_intervals BLOB,
+            resources_str TEXT,
+            num_nodes INTEGER,
+            hourly_cost REAL,
+            PRIMARY KEY (cluster_name));
+        CREATE TABLE IF NOT EXISTS config (
+            key TEXT PRIMARY KEY, value TEXT);
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at REAL,
+            handle BLOB,
+            status TEXT);
+    """)
+    conn.commit()
+
+
+# --------------------------------------------------------------------- #
+# Clusters
+# --------------------------------------------------------------------- #
+
+def add_or_update_cluster(name: str, handle: Any,
+                          status: ClusterStatus = ClusterStatus.INIT,
+                          is_launch: bool = False,
+                          config_hash: Optional[str] = None) -> None:
+    conn = _conn()
+    now = time.time()
+    row = conn.execute('SELECT launched_at FROM clusters WHERE name=?',
+                       (name,)).fetchone()
+    launched_at = now if (row is None or is_launch) else row[0]
+    conn.execute(
+        'INSERT INTO clusters (name, launched_at, handle, last_use, status,'
+        ' last_activity, config_hash) VALUES (?,?,?,?,?,?,?)'
+        ' ON CONFLICT(name) DO UPDATE SET launched_at=excluded.launched_at,'
+        ' handle=excluded.handle, status=excluded.status,'
+        ' last_activity=excluded.last_activity,'
+        ' config_hash=COALESCE(excluded.config_hash, clusters.config_hash)',
+        (name, launched_at, pickle.dumps(handle), '', status.value, now,
+         config_hash))
+    conn.commit()
+    if is_launch:
+        _record_history_start(name, handle)
+
+
+def set_cluster_status(name: str, status: ClusterStatus) -> None:
+    conn = _conn()
+    conn.execute('UPDATE clusters SET status=?, last_activity=? '
+                 'WHERE name=?', (status.value, time.time(), name))
+    conn.commit()
+    if status != ClusterStatus.UP:
+        _record_history_stop(name)
+
+
+def set_cluster_autostop(name: str, idle_minutes: int,
+                         to_down: bool) -> None:
+    conn = _conn()
+    conn.execute('UPDATE clusters SET autostop=?, to_down=? WHERE name=?',
+                 (idle_minutes, int(to_down), name))
+    conn.commit()
+
+
+def get_cluster(name: str) -> Optional[Dict[str, Any]]:
+    row = _conn().execute(
+        'SELECT name, launched_at, handle, status, autostop, to_down,'
+        ' last_activity, config_hash FROM clusters WHERE name=?',
+        (name,)).fetchone()
+    return _row_to_record(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT name, launched_at, handle, status, autostop, to_down,'
+        ' last_activity, config_hash FROM clusters '
+        'ORDER BY launched_at DESC').fetchall()
+    return [_row_to_record(r) for r in rows]
+
+
+def remove_cluster(name: str) -> None:
+    conn = _conn()
+    _record_history_stop(name)
+    conn.execute('DELETE FROM clusters WHERE name=?', (name,))
+    conn.commit()
+
+
+def _row_to_record(row) -> Dict[str, Any]:
+    return {
+        'name': row[0],
+        'launched_at': row[1],
+        'handle': pickle.loads(row[2]) if row[2] else None,
+        'status': ClusterStatus(row[3]),
+        'autostop': row[4],
+        'to_down': bool(row[5]),
+        'last_activity': row[6],
+        'config_hash': row[7],
+    }
+
+
+# --------------------------------------------------------------------- #
+# Cost history (reference: global_user_state.py:469-510)
+# --------------------------------------------------------------------- #
+
+def _record_history_start(name: str, handle: Any) -> None:
+    conn = _conn()
+    row = conn.execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_name=?',
+        (name,)).fetchone()
+    intervals = pickle.loads(row[0]) if row and row[0] else []
+    intervals.append((time.time(), None))
+    resources_str = str(getattr(handle, 'launched_resources', ''))
+    num_nodes = getattr(handle, 'launched_nodes', 1)
+    hourly = 0.0
+    res = getattr(handle, 'launched_resources', None)
+    if res is not None:
+        hourly = (res.hourly_price() or 0.0) * num_nodes
+    conn.execute(
+        'INSERT INTO cluster_history (cluster_name, usage_intervals,'
+        ' resources_str, num_nodes, hourly_cost) VALUES (?,?,?,?,?)'
+        ' ON CONFLICT(cluster_name) DO UPDATE SET'
+        ' usage_intervals=excluded.usage_intervals,'
+        ' resources_str=excluded.resources_str,'
+        ' num_nodes=excluded.num_nodes, hourly_cost=excluded.hourly_cost',
+        (name, pickle.dumps(intervals), resources_str, num_nodes, hourly))
+    conn.commit()
+
+
+def _record_history_stop(name: str) -> None:
+    conn = _conn()
+    row = conn.execute(
+        'SELECT usage_intervals FROM cluster_history WHERE cluster_name=?',
+        (name,)).fetchone()
+    if not row or not row[0]:
+        return
+    intervals = pickle.loads(row[0])
+    if intervals and intervals[-1][1] is None:
+        intervals[-1] = (intervals[-1][0], time.time())
+        conn.execute(
+            'UPDATE cluster_history SET usage_intervals=? '
+            'WHERE cluster_name=?', (pickle.dumps(intervals), name))
+        conn.commit()
+
+
+def get_cost_report() -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT cluster_name, usage_intervals, resources_str, num_nodes,'
+        ' hourly_cost FROM cluster_history').fetchall()
+    report = []
+    for name, blob, res_str, num_nodes, hourly in rows:
+        intervals = pickle.loads(blob) if blob else []
+        total_s = sum((end or time.time()) - start
+                      for start, end in intervals)
+        report.append({
+            'name': name,
+            'resources': res_str,
+            'num_nodes': num_nodes,
+            'duration_hours': total_s / 3600.0,
+            'cost': hourly * total_s / 3600.0,
+        })
+    return report
+
+
+# --------------------------------------------------------------------- #
+# Config KV (enabled clouds cache — reference: check.py:164)
+# --------------------------------------------------------------------- #
+
+def set_config_value(key: str, value: Any) -> None:
+    conn = _conn()
+    conn.execute('INSERT INTO config (key, value) VALUES (?,?)'
+                 ' ON CONFLICT(key) DO UPDATE SET value=excluded.value',
+                 (key, json.dumps(value)))
+    conn.commit()
+
+
+def get_config_value(key: str, default: Any = None) -> Any:
+    row = _conn().execute('SELECT value FROM config WHERE key=?',
+                          (key,)).fetchone()
+    return json.loads(row[0]) if row else default
+
+
+# --------------------------------------------------------------------- #
+# Storage
+# --------------------------------------------------------------------- #
+
+def add_or_update_storage(name: str, handle: Any, status: str) -> None:
+    conn = _conn()
+    conn.execute(
+        'INSERT INTO storage (name, launched_at, handle, status)'
+        ' VALUES (?,?,?,?) ON CONFLICT(name) DO UPDATE SET'
+        ' handle=excluded.handle, status=excluded.status',
+        (name, time.time(), pickle.dumps(handle), status))
+    conn.commit()
+
+
+def get_storage() -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT name, launched_at, handle, status FROM storage').fetchall()
+    return [{'name': r[0], 'launched_at': r[1],
+             'handle': pickle.loads(r[2]) if r[2] else None,
+             'status': r[3]} for r in rows]
+
+
+def remove_storage(name: str) -> None:
+    conn = _conn()
+    conn.execute('DELETE FROM storage WHERE name=?', (name,))
+    conn.commit()

@@ -1,0 +1,112 @@
+"""Optimizer: pick the cheapest (or fastest-to-acquire) feasible offering
+per task.
+
+Reference equivalent: sky/optimizer.py (1345 LoC: DP over chains at :411, ILP
+via pulp for general DAGs at :472). Our Dag is a chain by construction and
+tasks have no inter-task egress in the TPU-first design (data moves via GCS),
+so per-task independent minimization IS the chain DP — no ILP needed.
+
+The output contract matches the reference (`task.best_resources` gets filled,
+optimizer.py:110): each task's `best_resources` becomes a *launchable*
+Resources (cloud + concrete type + candidate zone ordering for failover).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+@dataclasses.dataclass
+class OptimizedPlan:
+    """Per-task choice plus the ordered failover candidates."""
+    task: task_lib.Task
+    chosen: 'object'            # TpuOffering | InstanceOffering
+    candidates: List[object]    # same, price-ascending: the failover order
+    hourly_cost: float
+
+
+def _default_cloud() -> str:
+    """'gcp' unless only the fake cloud is enabled (test environments)."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.get_cached_enabled_clouds()
+    if enabled == ['fake']:
+        return 'fake'
+    return 'gcp'
+
+
+def optimize_task(task: task_lib.Task,
+                  minimize: OptimizeTarget = OptimizeTarget.COST
+                  ) -> OptimizedPlan:
+    """Fill `task.best_resources`; return the plan with failover ordering."""
+    res = task.resources
+    offerings = res.get_offerings()
+    if not offerings:
+        raise exceptions.ResourcesUnavailableError(
+            f'No catalog offering matches {res}. '
+            f'Try `skyt show-tpus` for valid TPU types.')
+    # COST: price-ascending. TIME: same ordering for now — acquisition-time
+    # modeling (stockout history per zone) is a provisioner-level concern and
+    # feeds back via the failover blocklist.
+    offerings = sorted(offerings,
+                       key=lambda o: o.price(res.use_spot))
+    chosen = offerings[0]
+    cloud = res.cloud or _default_cloud()
+    if hasattr(chosen, 'topology'):
+        best = res.copy(cloud=cloud, tpu=chosen.topology,
+                        region=chosen.region if res.region else res.region,
+                        zone=res.zone)
+    else:
+        best = res.copy(cloud=cloud, instance_type=chosen.instance_type)
+    task.best_resources = best
+    per_node = chosen.price(res.use_spot)
+    return OptimizedPlan(task=task, chosen=chosen, candidates=offerings,
+                         hourly_cost=per_node * task.num_nodes)
+
+
+def optimize(dag: dag_lib.Dag,
+             minimize: OptimizeTarget = OptimizeTarget.COST,
+             quiet: bool = False) -> List[OptimizedPlan]:
+    """Optimize every task in the chain (reference: Optimizer.optimize,
+    sky/optimizer.py:110)."""
+    plans = [optimize_task(t, minimize) for t in dag.tasks]
+    if not quiet:
+        print(format_plan_table(plans))
+    return plans
+
+
+def format_plan_table(plans: List[OptimizedPlan]) -> str:
+    """Pretty plan table (reference prints via rich, optimizer.py:720)."""
+    header = ['TASK', 'RESOURCES', 'ZONE', '$/HR', 'CANDIDATE ZONES']
+    rows = []
+    for p in plans:
+        res = p.task.best_resources
+        zones = ', '.join(
+            dict.fromkeys(c.zone for c in p.candidates[:4]))
+        if len(p.candidates) > 4:
+            zones += f', +{len(p.candidates) - 4} more'
+        rows.append([
+            p.task.name or '-',
+            str(res.tpu) if res.tpu else (res.instance_type or 'cpu'),
+            p.candidates[0].zone,
+            f'{p.hourly_cost:.2f}',
+            zones,
+        ])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(r, widths)))
+    return '\n'.join(lines)

@@ -1,0 +1,269 @@
+"""Resources: an immutable resource request.
+
+Reference equivalent: sky/resources.py (1631 LoC). Differences by design:
+  * TPU topology is first-class (`Resources.tpu` is a TpuTopology), not an
+    accelerator-dict + `TPU-VM` pseudo-instance-type + accelerator_args
+    (reference: resources.py:545-629, gcp_catalog.py:222-247).
+  * GCP-only cloud registry ('gcp' for real, 'fake' for the localhost test
+    provider) — one cloud done deeply rather than 15 shallowly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import catalog
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_topology
+
+_DEFAULT_DISK_SIZE_GB = 100
+
+SUPPORTED_CLOUDS = ('gcp', 'fake')
+
+
+@dataclasses.dataclass(frozen=True)
+class Resources:
+    """One resource request. Frozen; use `.copy(**overrides)` to derive.
+
+    Exactly one of (tpu, instance_type, cpus/memory floors) drives sizing:
+      * tpu set            -> a TPU-VM slice (possibly multi-host pod)
+      * instance_type set  -> that GCE shape
+      * only cpus/memory   -> optimizer picks the cheapest adequate GCE shape
+    """
+    cloud: Optional[str] = None
+    tpu: Optional[tpu_topology.TpuTopology] = None
+    instance_type: Optional[str] = None
+    cpus: Optional[float] = None
+    memory_gb: Optional[float] = None
+    use_spot: bool = False
+    region: Optional[str] = None
+    zone: Optional[str] = None
+    disk_size_gb: int = _DEFAULT_DISK_SIZE_GB
+    image_id: Optional[str] = None
+    runtime_version: Optional[str] = None   # TPU VM runtime image override
+    ports: tuple = ()                        # ports to open, e.g. (8000,)
+    labels: Optional[Dict[str, str]] = None
+    job_recovery: Optional[str] = None       # managed-jobs strategy name
+    autostop_minutes: Optional[int] = None
+    autostop_down: bool = False
+
+    # ------------------------------------------------------------------ #
+    # Construction
+    # ------------------------------------------------------------------ #
+
+    def __post_init__(self):
+        if self.cloud is not None and self.cloud not in SUPPORTED_CLOUDS:
+            raise exceptions.InvalidResourcesError(
+                f'Unsupported cloud {self.cloud!r}; supported: '
+                f'{SUPPORTED_CLOUDS}')
+        if self.zone is not None or self.region is not None:
+            catalog.validate_region_zone(self.region, self.zone)
+        if self.tpu is not None and self.instance_type is not None:
+            raise exceptions.InvalidResourcesError(
+                'Specify either a TPU type or an instance_type, not both.')
+        if self.tpu is not None and self.tpu.is_pod and self.use_spot:
+            # Spot ("preemptible") pods are real; allowed. Stopping is not —
+            # enforced at the backend (pods support down only).
+            pass
+
+    @classmethod
+    def new(cls, *, accelerators: Union[None, str, Dict[str, int]] = None,
+            **kwargs) -> 'Resources':
+        """Build from user-level fields. `accelerators` accepts the reference
+        syntax ('tpu-v5e-8', {'tpu-v5e-8': 1}) for familiarity
+        (reference: resources.py:545 _set_accelerators)."""
+        tpu = kwargs.pop('tpu', None)
+        if accelerators is not None:
+            if isinstance(accelerators, dict):
+                if len(accelerators) != 1:
+                    raise exceptions.InvalidResourcesError(
+                        f'accelerators must name one type: {accelerators}')
+                name, count = next(iter(accelerators.items()))
+                if int(count) != 1:
+                    raise exceptions.InvalidResourcesError(
+                        'TPU requests take count 1 (the slice size is in the '
+                        f'type, e.g. tpu-v5p-64); got {accelerators}')
+                accelerators = name
+            tpu = tpu_topology.parse_tpu_type(accelerators)
+        if isinstance(tpu, str):
+            tpu = tpu_topology.parse_tpu_type(tpu)
+        return cls(tpu=tpu, **kwargs)
+
+    @classmethod
+    def from_yaml_config(cls, config: Optional[Dict[str, Any]]) -> 'Resources':
+        """Parse the `resources:` section of a task YAML.
+
+        Reference: sky/resources.py:1318 from_yaml_config. Accepted keys:
+        cloud, accelerators, instance_type, cpus, memory, use_spot, region,
+        zone, disk_size, image_id, runtime_version, ports, labels,
+        job_recovery, autostop.
+        """
+        if config is None:
+            return cls()
+        config = dict(config)
+        known = {}
+        known['cloud'] = config.pop('cloud', None)
+        accelerators = config.pop('accelerators', None)
+        known['instance_type'] = config.pop('instance_type', None)
+        cpus = config.pop('cpus', None)
+        if cpus is not None:
+            known['cpus'] = float(str(cpus).rstrip('+'))
+        memory = config.pop('memory', None)
+        if memory is not None:
+            known['memory_gb'] = float(str(memory).rstrip('+'))
+        known['use_spot'] = bool(config.pop('use_spot', False))
+        known['region'] = config.pop('region', None)
+        known['zone'] = config.pop('zone', None)
+        known['disk_size_gb'] = int(config.pop('disk_size',
+                                               _DEFAULT_DISK_SIZE_GB))
+        known['image_id'] = config.pop('image_id', None)
+        known['runtime_version'] = config.pop('runtime_version', None)
+        ports = config.pop('ports', None)
+        if ports is not None:
+            if not isinstance(ports, list):
+                ports = [ports]
+            known['ports'] = tuple(int(p) for p in ports)
+        known['labels'] = config.pop('labels', None)
+        known['job_recovery'] = config.pop('job_recovery', None)
+        autostop = config.pop('autostop', None)
+        if autostop is not None:
+            if isinstance(autostop, dict):
+                known['autostop_minutes'] = int(autostop.get('idle_minutes', 5))
+                known['autostop_down'] = bool(autostop.get('down', False))
+            else:
+                known['autostop_minutes'] = int(autostop)
+        # accelerator_args compatibility shim (reference YAMLs):
+        acc_args = config.pop('accelerator_args', None) or {}
+        if 'runtime_version' in acc_args and known['runtime_version'] is None:
+            known['runtime_version'] = acc_args['runtime_version']
+        if config:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown resources fields: {sorted(config)}')
+        return cls.new(accelerators=accelerators, **known)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.cloud:
+            cfg['cloud'] = self.cloud
+        if self.tpu is not None:
+            cfg['accelerators'] = f'tpu-{self.tpu.type_name}'
+        if self.instance_type:
+            cfg['instance_type'] = self.instance_type
+        if self.cpus is not None:
+            cfg['cpus'] = self.cpus
+        if self.memory_gb is not None:
+            cfg['memory'] = self.memory_gb
+        if self.use_spot:
+            cfg['use_spot'] = True
+        for k in ('region', 'zone', 'image_id', 'runtime_version',
+                  'job_recovery'):
+            v = getattr(self, k)
+            if v is not None:
+                cfg[k] = v
+        if self.disk_size_gb != _DEFAULT_DISK_SIZE_GB:
+            cfg['disk_size'] = self.disk_size_gb
+        if self.ports:
+            cfg['ports'] = list(self.ports)
+        if self.labels:
+            cfg['labels'] = dict(self.labels)
+        if self.autostop_minutes is not None:
+            cfg['autostop'] = {'idle_minutes': self.autostop_minutes,
+                               'down': self.autostop_down}
+        return cfg
+
+    def copy(self, **overrides) -> 'Resources':
+        if 'tpu' in overrides and isinstance(overrides['tpu'], str):
+            overrides['tpu'] = tpu_topology.parse_tpu_type(overrides['tpu'])
+        return dataclasses.replace(self, **overrides)
+
+    # ------------------------------------------------------------------ #
+    # Semantics
+    # ------------------------------------------------------------------ #
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.tpu is not None
+
+    @property
+    def is_launchable(self) -> bool:
+        """Concrete enough to hand to the provisioner: a cloud plus either a
+        TPU type or an instance type (reference: resources.py:630)."""
+        return (self.cloud is not None and
+                (self.tpu is not None or self.instance_type is not None))
+
+    def num_hosts(self) -> int:
+        """SSH targets per "node" of this resource: a pod slice surfaces as
+        N hosts (reference: CloudVmRayResourceHandle.num_ips_per_node,
+        cloud_vm_ray_backend.py:2551-2558)."""
+        return self.tpu.num_hosts if self.tpu is not None else 1
+
+    def get_offerings(self) -> List[Any]:
+        """Catalog offerings matching this request, cheapest first."""
+        if self.tpu is not None:
+            return catalog.get_tpu_offerings(self.tpu.type_name, self.region,
+                                             self.zone)
+        if self.instance_type is not None:
+            return catalog.get_instance_offerings(self.instance_type,
+                                                  self.region, self.zone)
+        # CPU-floor request: all adequate instance types.
+        out = []
+        for itype in catalog.list_instance_types():
+            for off in catalog.get_instance_offerings(itype, self.region,
+                                                      self.zone):
+                if ((self.cpus is None or off.vcpus >= self.cpus) and
+                        (self.memory_gb is None or
+                         off.memory_gb >= self.memory_gb)):
+                    out.append(off)
+        return sorted(out, key=lambda o: o.price(self.use_spot))
+
+    def hourly_price(self) -> Optional[float]:
+        """Cheapest matching offering's price, or None if nothing matches."""
+        offs = self.get_offerings()
+        if not offs:
+            return None
+        return min(o.price(self.use_spot) for o in offs)
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if a cluster with `other` can serve this request
+        (reference: resources.py:1119). Used for cluster reuse in exec."""
+        if self.cloud is not None and other.cloud is not None:
+            if self.cloud != other.cloud:
+                return False
+        if self.tpu is not None:
+            if other.tpu is None:
+                return False
+            if self.tpu.generation != other.tpu.generation:
+                return False
+            if self.tpu.num_chips > other.tpu.num_chips:
+                return False
+        if self.instance_type is not None:
+            if other.instance_type != self.instance_type:
+                return False
+        if self.use_spot and not other.use_spot:
+            pass  # a spot request can run on on-demand
+        if not self.use_spot and other.use_spot:
+            return False  # on-demand request can't be satisfied by spot
+        for region_attr in ('region', 'zone'):
+            want = getattr(self, region_attr)
+            have = getattr(other, region_attr)
+            if want is not None and have is not None and want != have:
+                return False
+        return True
+
+    def __str__(self) -> str:
+        parts = [self.cloud or 'any-cloud']
+        if self.tpu is not None:
+            parts.append(str(self.tpu))
+        elif self.instance_type:
+            parts.append(self.instance_type)
+        elif self.cpus or self.memory_gb:
+            parts.append(f'cpus={self.cpus} mem={self.memory_gb}')
+        else:
+            parts.append('default-cpu')
+        if self.use_spot:
+            parts.append('[spot]')
+        if self.zone:
+            parts.append(f'({self.zone})')
+        elif self.region:
+            parts.append(f'({self.region})')
+        return ' '.join(parts)

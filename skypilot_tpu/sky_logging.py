@@ -1,0 +1,58 @@
+"""Logging setup (reference: sky/sky_logging.py).
+
+Env knobs:
+  SKYT_DEBUG=1           -> DEBUG level everywhere
+  SKYT_MINIMIZE_LOGGING  -> WARNING level (used by controllers)
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+
+_FORMAT = '%(levelname).1s %(asctime)s %(name)s:%(lineno)d] %(message)s'
+_DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_root_configured = False
+
+
+def _level() -> int:
+    if os.environ.get('SKYT_DEBUG', '0') == '1':
+        return logging.DEBUG
+    if os.environ.get('SKYT_MINIMIZE_LOGGING', '0') == '1':
+        return logging.WARNING
+    return logging.INFO
+
+
+def init_logger(name: str) -> logging.Logger:
+    global _root_configured
+    logger = logging.getLogger(name)
+    if not _root_configured:
+        root = logging.getLogger('skypilot_tpu')
+        if not root.handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(logging.Formatter(_FORMAT, _DATE_FORMAT))
+            root.addHandler(handler)
+            root.setLevel(_level())
+            root.propagate = False
+        _root_configured = True
+    return logger
+
+
+@contextlib.contextmanager
+def silent():
+    """Temporarily silence framework logging (used by recursive launches)."""
+    root = logging.getLogger('skypilot_tpu')
+    prev = root.level
+    root.setLevel(logging.ERROR)
+    try:
+        yield
+    finally:
+        root.setLevel(prev)
+
+
+def print_status(msg: str) -> None:
+    """User-facing progress line (reference uses rich spinners; we keep it
+    plain so logs are greppable in CI)."""
+    print(f'\x1b[36m» {msg}\x1b[0m', flush=True)

@@ -1,0 +1,207 @@
+"""First-class TPU topology model.
+
+The reference treats TPUs as an opaque accelerator string plus a `TPU-VM`
+pseudo-instance-type (sky/clouds/service_catalog/gcp_catalog.py:222-247) and
+hardcodes host specs inside the GCP cloud class (sky/clouds/gcp.py:600-651).
+Here topology is a first-class object: every accelerator request like
+``tpu-v5p-64`` resolves to a `TpuTopology` that knows its chip count, host
+count, chips-per-host, ICI mesh shape, and peak FLOPs — which is what the
+optimizer (pricing is per chip-hour), the provisioner (a v5p-64 is ONE
+queued-resources call but EIGHT ssh targets), the gang executor (one process
+per host, rank = TPU worker id), and the MFU calculator all need.
+
+Naming convention (public Cloud TPU naming):
+  * v2 / v3 / v4 / v5p : the suffix counts **TensorCores** (2 cores/chip).
+    v4-8 = 4 chips; v5p-64 = 32 chips.
+  * v5e (v5litepod) / v6e : the suffix counts **chips**. v5e-8 = 8 chips.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGenerationInfo:
+    """Static per-generation hardware facts (public spec sheet numbers)."""
+    name: str
+    cores_per_chip: int
+    # How the public type suffix counts: 'cores' or 'chips'.
+    suffix_unit: str
+    chips_per_host: int             # for pod slices (max per host)
+    hbm_gb_per_chip: float
+    # Peak dense bf16 FLOP/s per chip (for MFU accounting).
+    bf16_flops_per_chip: float
+    # Largest single-host suffix (suffix units): requests at/below this fit
+    # on one host.
+    max_single_host_suffix: int
+    # Valid single-host sub-host sizes in suffix units (v5e/v6e support 1/4).
+    sub_host_suffixes: Tuple[int, ...] = ()
+
+
+# Public numbers: v2 45 TFLOPs/core bf16 -> 90e12/chip (2 cores);
+# v3 123e12/chip; v4 275e12/chip; v5e 197e12/chip (bf16); v5p 459e12/chip;
+# v6e (Trillium) 918e12/chip.
+TPU_GENERATIONS: Dict[str, TpuGenerationInfo] = {
+    'v2': TpuGenerationInfo('v2', 2, 'cores', 4, 8.0, 90e12, 8),
+    'v3': TpuGenerationInfo('v3', 2, 'cores', 4, 16.0, 123e12, 8),
+    'v4': TpuGenerationInfo('v4', 2, 'cores', 4, 32.0, 275e12, 8),
+    'v5e': TpuGenerationInfo('v5e', 1, 'chips', 8, 16.0, 197e12, 8, (1, 4)),
+    'v5p': TpuGenerationInfo('v5p', 2, 'cores', 4, 95.0, 459e12, 8),
+    'v6e': TpuGenerationInfo('v6e', 1, 'chips', 8, 32.0, 918e12, 8, (1, 4)),
+}
+
+# Aliases seen in the wild / in reference YAMLs (e.g. `tpu-v5litepod-8`).
+_GENERATION_ALIASES = {
+    'v5litepod': 'v5e',
+    'v5lite': 'v5e',
+    'v6e': 'v6e',
+}
+
+_TPU_TYPE_RE = re.compile(
+    r'^(?:tpu-)?(?P<gen>v\d+(?:e|p|litepod|lite)?)-(?P<suffix>\d+)$',
+    re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuTopology:
+    """A concrete TPU slice shape.
+
+    `type_name` is the canonical public name (e.g. 'v5p-64').
+    """
+    type_name: str
+    generation: str
+    num_chips: int
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def info(self) -> TpuGenerationInfo:
+        return TPU_GENERATIONS[self.generation]
+
+    @property
+    def num_cores(self) -> int:
+        return self.num_chips * self.info.cores_per_chip
+
+    @property
+    def is_pod(self) -> bool:
+        """Multi-host slice — atomic gang unit; cannot be stopped (the
+        reference gates this via CloudImplementationFeatures.STOP,
+        sky/clouds/gcp.py:193-197)."""
+        return self.num_hosts > 1
+
+    @property
+    def hbm_gb_total(self) -> float:
+        return self.num_chips * self.info.hbm_gb_per_chip
+
+    @property
+    def bf16_flops_total(self) -> float:
+        return self.num_chips * self.info.bf16_flops_per_chip
+
+    @property
+    def accelerator_type(self) -> str:
+        """The string the GCP TPU API v2 expects, e.g. 'v5p-64',
+        'v5litepod-8'."""
+        if self.generation == 'v5e':
+            suffix = self.num_chips
+            return f'v5litepod-{suffix}'
+        info = self.info
+        suffix = (self.num_cores if info.suffix_unit == 'cores'
+                  else self.num_chips)
+        return f'{self.generation}-{suffix}'
+
+    @property
+    def default_runtime_version(self) -> str:
+        """TPU VM runtime image (reference default: sky/resources.py:603
+        picks 'tpu-vm-base'; newer gens need their own)."""
+        return {
+            'v2': 'tpu-ubuntu2204-base',
+            'v3': 'tpu-ubuntu2204-base',
+            'v4': 'tpu-ubuntu2204-base',
+            'v5e': 'v2-alpha-tpuv5-lite',
+            'v5p': 'v2-alpha-tpuv5',
+            'v6e': 'v2-alpha-tpuv6e',
+        }[self.generation]
+
+    def mesh_shape_2d(self) -> Tuple[int, int]:
+        """A near-square 2D factorization of num_chips, the default data/model
+        mesh laid over ICI. (Real slices have 2D/3D torus shapes; XLA maps a
+        logical mesh onto the physical torus — the near-square split keeps
+        both axes ICI-local.)"""
+        n = self.num_chips
+        a = int(math.sqrt(n))
+        while n % a != 0:
+            a -= 1
+        return (n // a, a)
+
+    def __str__(self) -> str:
+        return (f'tpu-{self.type_name} ({self.num_chips} chips / '
+                f'{self.num_hosts} hosts)')
+
+
+def _canonical_generation(gen: str) -> str:
+    gen = gen.lower()
+    gen = _GENERATION_ALIASES.get(gen, gen)
+    if gen not in TPU_GENERATIONS:
+        raise exceptions.InvalidResourcesError(
+            f'Unknown TPU generation {gen!r}. Known: '
+            f'{sorted(TPU_GENERATIONS)}')
+    return gen
+
+
+def parse_tpu_type(tpu_type: str) -> TpuTopology:
+    """Parse 'tpu-v5p-64' / 'v5e-16' / 'tpu-v5litepod-8' into a topology.
+
+    Raises InvalidResourcesError for unknown generations or invalid sizes.
+    """
+    m = _TPU_TYPE_RE.match(tpu_type.strip())
+    if m is None:
+        raise exceptions.InvalidResourcesError(
+            f'Invalid TPU type {tpu_type!r}. Expected e.g. "tpu-v5e-8", '
+            f'"tpu-v5p-64".')
+    gen = _canonical_generation(m.group('gen'))
+    suffix = int(m.group('suffix'))
+    info = TPU_GENERATIONS[gen]
+
+    if info.suffix_unit == 'cores':
+        if suffix % info.cores_per_chip != 0:
+            raise exceptions.InvalidResourcesError(
+                f'TPU {tpu_type}: core count must be a multiple of '
+                f'{info.cores_per_chip}.')
+        num_chips = suffix // info.cores_per_chip
+    else:
+        num_chips = suffix
+
+    if num_chips <= 0:
+        raise exceptions.InvalidResourcesError(
+            f'TPU {tpu_type}: size must be positive.')
+
+    # Host layout: single-host below the threshold, full hosts for pods.
+    if suffix <= info.max_single_host_suffix or num_chips <= info.chips_per_host:
+        num_hosts = 1
+        chips_per_host = num_chips
+    else:
+        if num_chips % info.chips_per_host != 0:
+            raise exceptions.InvalidResourcesError(
+                f'TPU {tpu_type}: pod slices must be a multiple of '
+                f'{info.chips_per_host} chips per host.')
+        num_hosts = num_chips // info.chips_per_host
+        chips_per_host = info.chips_per_host
+
+    canonical = f'{gen}-{suffix}'
+    return TpuTopology(type_name=canonical, generation=gen,
+                       num_chips=num_chips, num_hosts=num_hosts,
+                       chips_per_host=chips_per_host)
+
+
+def is_tpu_type(name: str) -> bool:
+    """True if `name` looks like a TPU accelerator request."""
+    try:
+        parse_tpu_type(name)
+        return True
+    except exceptions.InvalidResourcesError:
+        return False

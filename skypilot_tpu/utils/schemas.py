@@ -1,0 +1,88 @@
+"""Minimal declarative schema validation for task / service YAMLs.
+
+Reference equivalent: sky/utils/schemas.py (977 LoC of JSON-schema dicts fed
+to jsonschema). We validate with a tiny in-repo checker instead of the
+jsonschema package: the error messages name the offending key path, which is
+what users actually need.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from skypilot_tpu import exceptions
+
+# key -> allowed python types (None means "any")
+TASK_FIELDS: Dict[str, Any] = {
+    'name': str,
+    'workdir': str,
+    'num_nodes': int,
+    'setup': str,
+    'run': str,
+    'envs': dict,
+    'file_mounts': dict,
+    'resources': dict,
+    'service': dict,
+    'inputs': dict,     # accepted for reference-YAML compat, unused
+    'outputs': dict,    # accepted for reference-YAML compat, unused
+}
+
+SERVICE_FIELDS: Dict[str, Any] = {
+    'readiness_probe': None,   # str path or dict
+    'replica_policy': dict,
+    'replicas': int,
+    'ports': int,
+    'load_balancing_policy': str,
+}
+
+REPLICA_POLICY_FIELDS: Dict[str, Any] = {
+    'min_replicas': int,
+    'max_replicas': int,
+    'target_qps_per_replica': (int, float),
+    'upscale_delay_seconds': int,
+    'downscale_delay_seconds': int,
+    'base_ondemand_fallback_replicas': int,
+    'dynamic_ondemand_fallback': bool,
+}
+
+
+def check_fields(config: Dict[str, Any], allowed: Dict[str, Any],
+                 context: str) -> None:
+    if not isinstance(config, dict):
+        raise exceptions.InvalidTaskError(
+            f'{context}: expected a mapping, got {type(config).__name__}')
+    for key, value in config.items():
+        if key not in allowed:
+            raise exceptions.InvalidTaskError(
+                f'{context}: unknown field {key!r}. Allowed: '
+                f'{sorted(allowed)}')
+        want = allowed[key]
+        if want is not None and value is not None \
+                and not isinstance(value, want):
+            name = (want.__name__ if isinstance(want, type)
+                    else '/'.join(t.__name__ for t in want))
+            raise exceptions.InvalidTaskError(
+                f'{context}.{key}: expected {name}, got '
+                f'{type(value).__name__}')
+
+
+def validate_task_config(config: Dict[str, Any]) -> None:
+    check_fields(config, TASK_FIELDS, 'task')
+    if 'envs' in config and config['envs'] is not None:
+        for k, v in config['envs'].items():
+            if not isinstance(k, str):
+                raise exceptions.InvalidTaskError(
+                    f'task.envs: keys must be strings, got {k!r}')
+            if v is not None and not isinstance(v, (str, int, float)):
+                raise exceptions.InvalidTaskError(
+                    f'task.envs.{k}: value must be a scalar, got '
+                    f'{type(v).__name__}')
+    if 'num_nodes' in config and config['num_nodes'] is not None:
+        if config['num_nodes'] < 1:
+            raise exceptions.InvalidTaskError('task.num_nodes must be >= 1')
+
+
+def validate_service_config(config: Dict[str, Any]) -> None:
+    check_fields(config, SERVICE_FIELDS, 'service')
+    if 'replica_policy' in config and config['replica_policy'] is not None:
+        check_fields(config['replica_policy'], REPLICA_POLICY_FIELDS,
+                     'service.replica_policy')

@@ -1,0 +1,24 @@
+"""Test harness config.
+
+* Forces JAX onto a virtual 8-device CPU mesh (multi-chip sharding tests run
+  without TPU hardware — the reference has no such substrate; SURVEY.md §4
+  flags this as the gap to close).
+* Gives every test a hermetic SKYT_HOME and enables the fake cloud.
+"""
+import os
+
+# Must happen before any jax import anywhere in the test process.
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_state(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYT_HOME', str(tmp_path / 'skyt_home'))
+    monkeypatch.setenv('SKYT_ENABLE_FAKE_CLOUD', '1')
+    yield

@@ -1,0 +1,78 @@
+"""Optimizer dryrun tests (reference analog: tests/test_optimizer_dryruns.py,
+which runs the optimizer with all clouds monkey-patched enabled; our fake
+cloud + hermetic SKYT_HOME serves the same purpose)."""
+import pytest
+
+from skypilot_tpu import Resources, Task, dag as dag_lib, exceptions
+from skypilot_tpu import optimizer
+
+
+def _optimize_one(task):
+    return optimizer.optimize(dag_lib.to_dag(task), quiet=True)[0]
+
+
+def test_tpu_choice_cheapest_zone():
+    t = Task(run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v5e-8'))
+    plan = _optimize_one(t)
+    # us zones are cheapest (multiplier 1.0).
+    assert plan.candidates[0].zone.startswith('us-')
+    assert plan.hourly_cost == pytest.approx(8 * 1.20)
+    assert t.best_resources.is_launchable
+
+
+def test_zone_pin_respected():
+    t = Task(run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v5e-8',
+                                  zone='europe-west4-b'))
+    plan = _optimize_one(t)
+    assert all(c.zone == 'europe-west4-b' for c in plan.candidates)
+    assert plan.hourly_cost == pytest.approx(8 * 1.20 * 1.10)
+
+
+def test_v4_only_zone():
+    t = Task(run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v4-32'))
+    plan = _optimize_one(t)
+    assert {c.zone for c in plan.candidates} == {'us-central2-b'}
+
+
+def test_spot_cheaper():
+    def cost(spot):
+        t = Task(run='true')
+        t.set_resources(Resources.new(accelerators='tpu-v5p-8',
+                                      use_spot=spot))
+        return _optimize_one(t).hourly_cost
+    assert cost(True) < cost(False)
+
+
+def test_infeasible_raises():
+    t = Task(run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v5p-8',
+                                  region='asia-east1'))
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        _optimize_one(t)
+
+
+def test_cpu_task_picks_cheapest_adequate():
+    t = Task(run='true')
+    t.set_resources(Resources.from_yaml_config({'cpus': 2}))
+    plan = _optimize_one(t)
+    assert plan.chosen.vcpus >= 2
+    # e2-standard-2 at $0.067 is the floor in us zones.
+    assert plan.hourly_cost == pytest.approx(0.067)
+
+
+def test_num_nodes_multiplies_cost():
+    t = Task(run='true', num_nodes=4)
+    t.set_resources(Resources.new(accelerators='tpu-v5e-8'))
+    plan = _optimize_one(t)
+    assert plan.hourly_cost == pytest.approx(4 * 8 * 1.20)
+
+
+def test_plan_table_renders():
+    t = Task(name='x', run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v6e-8'))
+    plans = optimizer.optimize(dag_lib.to_dag(t), quiet=True)
+    table = optimizer.format_plan_table(plans)
+    assert 'v6e-8' in table and '$/HR' in table

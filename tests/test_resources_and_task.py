@@ -1,0 +1,115 @@
+"""Resources/Task/Dag spec tests (reference analogs:
+tests/unit_tests/test_resources.py, tests/test_yaml_parser.py)."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import Dag, Resources, Task, exceptions
+
+
+def test_resources_from_yaml_tpu():
+    r = Resources.from_yaml_config({
+        'accelerators': 'tpu-v5p-64',
+        'use_spot': True,
+        'region': 'us-east5',
+    })
+    assert r.tpu.type_name == 'v5p-64'
+    assert r.tpu.num_hosts == 8
+    assert r.use_spot
+    assert r.num_hosts() == 8
+
+
+def test_resources_accelerator_dict_form():
+    r = Resources.from_yaml_config({'accelerators': {'tpu-v5e-8': 1}})
+    assert r.tpu.num_chips == 8
+
+
+def test_resources_reference_accelerator_args_shim():
+    r = Resources.from_yaml_config({
+        'accelerators': 'tpu-v2-8',
+        'accelerator_args': {'runtime_version': 'tpu-vm-base'},
+    })
+    assert r.runtime_version == 'tpu-vm-base'
+
+
+def test_resources_cpu_floor():
+    r = Resources.from_yaml_config({'cpus': '4+', 'memory': '16+'})
+    offs = r.get_offerings()
+    assert offs and all(o.vcpus >= 4 and o.memory_gb >= 16 for o in offs)
+
+
+def test_resources_rejects_unknown_fields():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources.from_yaml_config({'acelerators': 'tpu-v5e-8'})
+
+
+def test_resources_rejects_bad_zone():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        Resources.from_yaml_config({'zone': 'mars-central1-a'})
+
+
+def test_resources_yaml_roundtrip():
+    cfg = {'accelerators': 'tpu-v5e-16', 'use_spot': True,
+           'zone': 'us-west4-a', 'disk_size': 256}
+    r = Resources.from_yaml_config(cfg)
+    r2 = Resources.from_yaml_config(r.to_yaml_config())
+    assert r2.tpu == r.tpu and r2.use_spot and r2.zone == 'us-west4-a'
+    assert r2.disk_size_gb == 256
+
+
+def test_less_demanding_than():
+    small = Resources.new(accelerators='tpu-v5e-8')
+    big = Resources.new(accelerators='tpu-v5e-16')
+    other_gen = Resources.new(accelerators='tpu-v4-8')
+    assert small.less_demanding_than(big)
+    assert not big.less_demanding_than(small)
+    assert not small.less_demanding_than(other_gen)
+
+
+def test_pricing():
+    r = Resources.new(accelerators='tpu-v5e-8')
+    od = r.hourly_price()
+    spot = r.copy(use_spot=True).hourly_price()
+    assert od == pytest.approx(8 * 1.20)
+    assert spot < od
+
+
+def test_task_yaml_roundtrip(tmp_path):
+    yaml_text = textwrap.dedent("""\
+        name: train
+        num_nodes: 2
+        resources:
+          accelerators: tpu-v5p-64
+          use_spot: true
+        envs:
+          MODEL: llama3-8b
+        setup: pip list
+        run: |
+          echo "rank $SKY_NODE_RANK"
+    """)
+    p = tmp_path / 'task.yaml'
+    p.write_text(yaml_text)
+    t = Task.from_yaml(str(p))
+    assert t.name == 'train'
+    assert t.num_nodes == 2
+    assert t.total_hosts == 16   # 2 slices x 8 hosts
+    assert t.envs['MODEL'] == 'llama3-8b'
+    t2 = Task.from_yaml_config(t.to_yaml_config())
+    assert t2.resources.tpu.type_name == 'v5p-64'
+
+
+def test_task_rejects_unknown_field():
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml_config({'run': 'true', 'nodess': 3})
+
+
+def test_task_callable_run():
+    t = Task(run=lambda rank, ips: f'echo {rank}/{len(ips)}')
+    assert t.get_command(1, ['a', 'b']) == 'echo 1/2'
+
+
+def test_dag_context():
+    with Dag('d') as d:
+        d.add(Task(name='a', run='true'))
+        d.add(Task(name='b', run='true'))
+    assert len(d) == 2 and d.is_chain

@@ -1,0 +1,69 @@
+"""TPU topology parsing tests (the reference has no topology model to test;
+its closest analog is accelerator-name resolution in
+tests/test_optimizer_dryruns.py)."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import tpu_topology
+
+
+@pytest.mark.parametrize('name,chips,hosts,cph', [
+    ('tpu-v2-8', 4, 1, 4),
+    ('tpu-v3-32', 16, 4, 4),
+    ('tpu-v4-8', 4, 1, 4),
+    ('tpu-v4-32', 16, 4, 4),
+    ('tpu-v5e-1', 1, 1, 1),
+    ('tpu-v5e-4', 4, 1, 4),
+    ('tpu-v5e-8', 8, 1, 8),
+    ('tpu-v5e-16', 16, 2, 8),
+    ('tpu-v5e-256', 256, 32, 8),
+    ('tpu-v5p-8', 4, 1, 4),
+    ('tpu-v5p-64', 32, 8, 4),
+    ('tpu-v6e-8', 8, 1, 8),
+    ('tpu-v6e-64', 64, 8, 8),
+])
+def test_parse(name, chips, hosts, cph):
+    t = tpu_topology.parse_tpu_type(name)
+    assert t.num_chips == chips
+    assert t.num_hosts == hosts
+    assert t.chips_per_host == cph
+
+
+def test_aliases_and_prefix():
+    assert tpu_topology.parse_tpu_type('v5litepod-8').type_name == 'v5e-8'
+    assert tpu_topology.parse_tpu_type('V5P-8').type_name == 'v5p-8'
+    assert tpu_topology.parse_tpu_type('tpu-v6e-4').generation == 'v6e'
+
+
+def test_accelerator_type_api_string():
+    assert tpu_topology.parse_tpu_type('v5e-16').accelerator_type == \
+        'v5litepod-16'
+    assert tpu_topology.parse_tpu_type('v5p-64').accelerator_type == 'v5p-64'
+    assert tpu_topology.parse_tpu_type('v4-32').accelerator_type == 'v4-32'
+
+
+def test_pod_flag_and_flops():
+    pod = tpu_topology.parse_tpu_type('v5p-128')
+    assert pod.is_pod
+    single = tpu_topology.parse_tpu_type('v5e-8')
+    assert not single.is_pod
+    assert single.bf16_flops_total == 8 * 197e12
+
+
+def test_invalid():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v99-8')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('h100')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v4-7')  # not a core multiple
+
+
+def test_mesh_shape():
+    assert tpu_topology.parse_tpu_type('v5e-16').mesh_shape_2d() == (4, 4)
+    assert tpu_topology.parse_tpu_type('v4-8').mesh_shape_2d() == (2, 2)
+
+
+def test_is_tpu_type():
+    assert tpu_topology.is_tpu_type('tpu-v5e-8')
+    assert not tpu_topology.is_tpu_type('a100-80gb')
