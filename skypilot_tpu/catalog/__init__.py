@@ -49,9 +49,10 @@ class InstanceOffering:
 
 
 def _ensure_csvs() -> None:
+    from skypilot_tpu.catalog import fetcher
     if not (_DATA_DIR / 'tpu_catalog.csv').exists():
-        from skypilot_tpu.catalog import fetcher
         fetcher.generate_tpu_csv(_DATA_DIR / 'tpu_catalog.csv')
+    if not (_DATA_DIR / 'gce_catalog.csv').exists():
         fetcher.generate_gce_csv(_DATA_DIR / 'gce_catalog.csv')
 
 

@@ -167,7 +167,10 @@ def _record_history_start(name: str, handle: Any) -> None:
         'SELECT usage_intervals FROM cluster_history WHERE cluster_name=?',
         (name,)).fetchone()
     intervals = pickle.loads(row[0]) if row and row[0] else []
-    intervals.append((time.time(), None))
+    # Re-launching onto a still-UP cluster must not open a second interval —
+    # get_cost_report treats an open interval as still-accruing.
+    if not intervals or intervals[-1][1] is not None:
+        intervals.append((time.time(), None))
     resources_str = str(getattr(handle, 'launched_resources', ''))
     num_nodes = getattr(handle, 'launched_nodes', 1)
     hourly = 0.0

@@ -64,12 +64,16 @@ def optimize_task(task: task_lib.Task,
                        key=lambda o: o.price(res.use_spot))
     chosen = offerings[0]
     cloud = res.cloud or _default_cloud()
+    # Record the chosen placement so the provisioner sees the optimizer's
+    # choice; keep the user's zone pin (None lets failover roam zones within
+    # the chosen region first, then other candidate regions).
+    region = res.region if res.region is not None else chosen.region
     if hasattr(chosen, 'topology'):
-        best = res.copy(cloud=cloud, tpu=chosen.topology,
-                        region=chosen.region if res.region else res.region,
+        best = res.copy(cloud=cloud, tpu=chosen.topology, region=region,
                         zone=res.zone)
     else:
-        best = res.copy(cloud=cloud, instance_type=chosen.instance_type)
+        best = res.copy(cloud=cloud, instance_type=chosen.instance_type,
+                        region=region)
     task.best_resources = best
     per_node = chosen.price(res.use_spot)
     return OptimizedPlan(task=task, chosen=chosen, candidates=offerings,

@@ -61,10 +61,8 @@ class Resources:
         if self.tpu is not None and self.instance_type is not None:
             raise exceptions.InvalidResourcesError(
                 'Specify either a TPU type or an instance_type, not both.')
-        if self.tpu is not None and self.tpu.is_pod and self.use_spot:
-            # Spot ("preemptible") pods are real; allowed. Stopping is not —
-            # enforced at the backend (pods support down only).
-            pass
+        # Note: spot ("preemptible") pods are allowed; *stopping* a pod is
+        # not — that is enforced at the backend (pods support down only).
 
     @classmethod
     def new(cls, *, accelerators: Union[None, str, Dict[str, int]] = None,
@@ -239,8 +237,7 @@ class Resources:
         if self.instance_type is not None:
             if other.instance_type != self.instance_type:
                 return False
-        if self.use_spot and not other.use_spot:
-            pass  # a spot request can run on on-demand
+        # A spot request can run on an on-demand cluster; not vice versa.
         if not self.use_spot and other.use_spot:
             return False  # on-demand request can't be satisfied by spot
         for region_attr in ('region', 'zone'):

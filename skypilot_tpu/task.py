@@ -82,14 +82,18 @@ class Task:
                          ) -> 'Task':
         config = dict(config or {})
         schemas.validate_task_config(config)
-        envs = {k: ('' if v is None else str(v))
-                for k, v in (config.get('envs') or {}).items()}
+        # A None-valued env is "required": the user must supply it via
+        # overrides (`--env K=V`), matching the reference's required-env
+        # pattern (e.g. `envs: {HF_TOKEN: null}` in llm/ recipes).
+        raw_envs = dict(config.get('envs') or {})
         if env_overrides:
-            envs.update({k: str(v) for k, v in env_overrides.items()})
-        # Unset (None-valued) envs without overrides are an error, matching
-        # the reference's required-env behavior.
-        missing = [k for k, v in envs.items() if v == '']
-        del missing  # empty-string envs are allowed; keep behavior simple.
+            raw_envs.update(env_overrides)
+        missing = sorted(k for k, v in raw_envs.items() if v is None)
+        if missing:
+            raise exceptions.InvalidTaskError(
+                f'Required envs not set: {missing}. Pass them via '
+                f'env_overrides / --env.')
+        envs = {k: str(v) for k, v in raw_envs.items()}
 
         task = cls(
             name=config.get('name'),

@@ -76,3 +76,10 @@ def test_plan_table_renders():
     plans = optimizer.optimize(dag_lib.to_dag(t), quiet=True)
     table = optimizer.format_plan_table(plans)
     assert 'v6e-8' in table and '$/HR' in table
+
+
+def test_unpinned_request_records_chosen_region():
+    t = Task(run='true')
+    t.set_resources(Resources.new(accelerators='tpu-v4-8'))
+    _optimize_one(t)
+    assert t.best_resources.region == 'us-central2'

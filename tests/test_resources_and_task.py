@@ -113,3 +113,12 @@ def test_dag_context():
         d.add(Task(name='a', run='true'))
         d.add(Task(name='b', run='true'))
     assert len(d) == 2 and d.is_chain
+
+
+def test_required_env_enforced(tmp_path):
+    p = tmp_path / 't.yaml'
+    p.write_text('run: echo $HF_TOKEN\nenvs:\n  HF_TOKEN:\n')
+    with pytest.raises(exceptions.InvalidTaskError):
+        Task.from_yaml(str(p))
+    t = Task.from_yaml(str(p), env_overrides={'HF_TOKEN': 'abc'})
+    assert t.envs['HF_TOKEN'] == 'abc'

@@ -67,3 +67,12 @@ def test_mesh_shape():
 def test_is_tpu_type():
     assert tpu_topology.is_tpu_type('tpu-v5e-8')
     assert not tpu_topology.is_tpu_type('a100-80gb')
+
+
+def test_sub_host_sizes_enforced():
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v5e-3')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v6e-7')
+    # v2-v5p have no sub-host shapes defined; multiples of cores still parse.
+    assert tpu_topology.parse_tpu_type('tpu-v4-4').num_chips == 2
