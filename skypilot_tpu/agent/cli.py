@@ -1,0 +1,172 @@
+"""On-head agent CLI — the client→cluster RPC surface.
+
+The reference builds `python3 -u -c "…"` snippets client-side and pipes them
+over SSH (JobLibCodeGen, skylet/job_lib.py:930) — string codegen as RPC. We
+instead ship this module with the runtime and call stable subcommands:
+
+    python -m skypilot_tpu.agent.cli submit --job-file <path>
+    python -m skypilot_tpu.agent.cli queue [--json]
+    python -m skypilot_tpu.agent.cli cancel <job_id | all>
+    python -m skypilot_tpu.agent.cli tail <job_id> [--follow/--no-follow]
+    python -m skypilot_tpu.agent.cli status <job_id>
+    python -m skypilot_tpu.agent.cli idle-seconds
+
+Machine-readable lines are prefixed with 'SKYT_JSON: ' so callers can grep
+them out of mixed SSH output.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import sys
+import time
+
+from skypilot_tpu.agent import job_lib
+
+
+def _emit(obj) -> None:
+    print('SKYT_JSON: ' + json.dumps(obj), flush=True)
+
+
+def cmd_submit(args) -> None:
+    with open(os.path.expanduser(args.job_file)) as f:
+        spec = json.load(f)
+    job_id = job_lib.add_job(spec.get('name') or '-', spec)
+    # Move the staged job dir (scripts were uploaded under a temp name).
+    staged = os.path.dirname(os.path.expanduser(args.job_file))
+    final = job_lib.job_dir(job_id)
+    for fname in os.listdir(staged):
+        os.replace(os.path.join(staged, fname), os.path.join(final, fname))
+    from skypilot_tpu.agent import executor
+    executor.spawn_detached(job_id)
+    _emit({'job_id': job_id})
+
+
+def cmd_queue(args) -> None:
+    del args
+    jobs = job_lib.get_jobs()
+    _emit([{'job_id': j['job_id'], 'name': j['name'],
+            'status': j['status'].value,
+            'submitted_at': j['submitted_at'],
+            'started_at': j['started_at'], 'ended_at': j['ended_at']}
+           for j in jobs])
+
+
+def cmd_status(args) -> None:
+    job = job_lib.get_job(args.job_id)
+    _emit(None if job is None else {'job_id': job['job_id'],
+                                    'status': job['status'].value})
+
+
+def cmd_cancel(args) -> None:
+    if args.job_id == 'all':
+        jobs = [j for j in job_lib.get_jobs()
+                if not j['status'].is_terminal()]
+    else:
+        job = job_lib.get_job(int(args.job_id))
+        jobs = [job] if job else []
+    cancelled = []
+    for job in jobs:
+        if job['status'].is_terminal():
+            continue
+        job_lib.set_status(job['job_id'], job_lib.JobStatus.CANCELLED)
+        pid = job['executor_pid']
+        if pid:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        # Executor may already be gone: best-effort direct host kill.
+        from skypilot_tpu.agent import executor
+        try:
+            executor.GangExecutor(job['job_id']).kill_all()
+        except Exception:  # noqa: BLE001
+            pass
+        cancelled.append(job['job_id'])
+    _emit({'cancelled': cancelled})
+
+
+def cmd_tail(args) -> None:
+    """Stream all rank logs (multiplexed with rank prefixes) until the job
+    terminates (reference: log_lib._follow_job_logs, :302-450)."""
+    job_id = args.job_id
+    log_dir = job_lib.log_dir(job_id)
+    offsets = {}
+    printed_header = set()
+
+    def _pump() -> bool:
+        wrote = False
+        files = sorted(glob.glob(os.path.join(log_dir, '*.log')))
+        for path in files:
+            base = os.path.basename(path)
+            try:
+                with open(path, 'r', errors='replace') as f:
+                    f.seek(offsets.get(path, 0))
+                    chunk = f.read()
+                    offsets[path] = f.tell()
+            except OSError:
+                continue
+            if chunk:
+                wrote = True
+                label = base[:-4]
+                if base not in printed_header:
+                    printed_header.add(base)
+                for line in chunk.splitlines():
+                    print(f'({label}) {line}', flush=True)
+        return wrote
+
+    while True:
+        job = job_lib.get_job(job_id)
+        if job is None:
+            print(f'Job {job_id} not found.', file=sys.stderr)
+            sys.exit(2)
+        _pump()
+        if job['status'].is_terminal():
+            _pump()
+            print(f"[skyt] Job {job_id} {job['status'].value}.", flush=True)
+            sys.exit(0 if job['status'] == job_lib.JobStatus.SUCCEEDED
+                     else 100)
+        if not args.follow:
+            sys.exit(0)
+        time.sleep(0.2)
+
+
+def cmd_idle_seconds(args) -> None:
+    del args
+    if not job_lib.is_idle():
+        _emit({'idle_seconds': 0})
+        return
+    last = job_lib.last_activity_time()
+    _emit({'idle_seconds': time.time() - last if last else 0})
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skyt-agent')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    p = sub.add_parser('submit')
+    p.add_argument('--job-file', required=True)
+    p.set_defaults(fn=cmd_submit)
+    p = sub.add_parser('queue')
+    p.set_defaults(fn=cmd_queue)
+    p = sub.add_parser('status')
+    p.add_argument('job_id', type=int)
+    p.set_defaults(fn=cmd_status)
+    p = sub.add_parser('cancel')
+    p.add_argument('job_id')
+    p.set_defaults(fn=cmd_cancel)
+    p = sub.add_parser('tail')
+    p.add_argument('job_id', type=int)
+    p.add_argument('--follow', action=argparse.BooleanOptionalAction,
+                   default=True)
+    p.set_defaults(fn=cmd_tail)
+    p = sub.add_parser('idle-seconds')
+    p.set_defaults(fn=cmd_idle_seconds)
+    args = parser.parse_args()
+    args.fn(args)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,50 @@
+"""On-cluster paths + the env contract (reference: sky/skylet/constants.py).
+
+The rendezvous contract is the TPU-first upgrade of the reference's
+SKYPILOT_NODE_* vars (skylet/constants.py:296-299): besides node
+rank/ips/count we export exactly what `jax.distributed.initialize` needs
+(coordinator address, process count, process id = global host rank) and the
+megascale vars multislice DCN training reads. SKYPILOT_* aliases are kept so
+reference recipes run unmodified.
+"""
+
+# All agent state lives under $HOME of the host (fake hosts remap HOME).
+AGENT_HOME = '~/.skyt_agent'
+JOBS_DB = f'{AGENT_HOME}/jobs.db'
+CLUSTER_INFO = f'{AGENT_HOME}/cluster_info.json'
+JOBS_DIR = f'{AGENT_HOME}/jobs'
+LOGS_DIR = f'{AGENT_HOME}/logs'
+AUTOSTOP_CONFIG = f'{AGENT_HOME}/autostop.json'
+WORKDIR = '~/sky_workdir'
+# Where the framework source is synced on every host (reference rsyncs a
+# built wheel, backends/wheel_utils.py; we rsync the package source).
+RUNTIME_DIR = f'{AGENT_HOME}/runtime'
+
+JAX_COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+
+# Env contract -------------------------------------------------------- #
+ENV_NODE_RANK = 'SKYT_NODE_RANK'            # slice index within the task
+ENV_NODE_IPS = 'SKYT_NODE_IPS'              # newline-separated, node order
+ENV_NUM_NODES = 'SKYT_NUM_NODES'            # number of slices
+ENV_HOST_RANK = 'SKYT_HOST_RANK'            # host index within the slice
+ENV_NUM_HOSTS_PER_NODE = 'SKYT_NUM_HOSTS_PER_NODE'
+ENV_TASK_ID = 'SKYT_TASK_ID'
+ENV_CHIPS_PER_HOST = 'SKYT_CHIPS_PER_HOST'
+
+ENV_PROCESS_ID = 'SKYT_PROCESS_ID'          # global host rank
+ENV_NUM_PROCESSES = 'SKYT_NUM_PROCESSES'    # total hosts
+ENV_COORDINATOR = 'SKYT_COORDINATOR_ADDRESS'  # host0:8476
+
+# Multislice (DCN) — read by libtpu/XLA for multi-slice meshes.
+ENV_MEGASCALE_COORDINATOR = 'MEGASCALE_COORDINATOR_ADDRESS'
+ENV_MEGASCALE_NUM_SLICES = 'MEGASCALE_NUM_SLICES'
+ENV_MEGASCALE_SLICE_ID = 'MEGASCALE_SLICE_ID'
+
+# Reference-compat aliases (examples/recipes written for SkyPilot).
+COMPAT_ALIASES = {
+    'SKYPILOT_NODE_RANK': ENV_NODE_RANK,
+    'SKYPILOT_NODE_IPS': ENV_NODE_IPS,
+    'SKYPILOT_NUM_NODES': ENV_NUM_NODES,
+    'SKYPILOT_TASK_ID': ENV_TASK_ID,
+}

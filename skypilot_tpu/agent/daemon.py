@@ -1,0 +1,75 @@
+"""Head-node daemon (reference: sky/skylet/skylet.py — 20s event loop).
+
+Events:
+  * AutostopEvent: if ~/.skyt_agent/autostop.json is set and the job queue
+    has been idle longer than the configured minutes, tear the cluster down
+    (or stop it) from *inside* the cluster by calling the provider API
+    (reference: skylet/events.py:141-266 re-writes the cluster YAML and
+    calls stop/down in-cluster).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+
+LOOP_SECONDS = 20
+
+
+def _read_json(path: str):
+    p = os.path.expanduser(path)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def check_autostop() -> None:
+    cfg = _read_json(constants.AUTOSTOP_CONFIG)
+    if not cfg or cfg.get('idle_minutes', -1) < 0:
+        return
+    if not job_lib.is_idle():
+        return
+    last = job_lib.last_activity_time()
+    boot_marker = os.path.expanduser(f'{constants.AGENT_HOME}/started_at')
+    if not last:
+        # No jobs ever: count idleness from daemon start.
+        if not os.path.exists(boot_marker):
+            with open(boot_marker, 'w') as f:
+                f.write(str(time.time()))
+            return
+        with open(boot_marker) as f:
+            last = float(f.read().strip() or 0)
+    idle_minutes = (time.time() - last) / 60.0
+    if idle_minutes < cfg['idle_minutes']:
+        return
+    # Tear down from inside: the cluster info names the provider; call it.
+    info = _read_json(constants.CLUSTER_INFO)
+    if info is None:
+        return
+    from skypilot_tpu import provision
+    cluster_name = info['cluster_name']
+    if cfg.get('down', False) or info.get('is_pod', False):
+        provision.terminate_instances(info['provider_name'], cluster_name)
+    else:
+        try:
+            provision.stop_instances(info['provider_name'], cluster_name)
+        except Exception:  # noqa: BLE001 — pods can't stop; fall back
+            provision.terminate_instances(info['provider_name'],
+                                          cluster_name)
+
+
+def main() -> None:
+    while True:
+        try:
+            check_autostop()
+        except Exception as e:  # noqa: BLE001 — daemon must survive
+            print(f'[daemon] event error: {e}', flush=True)
+        time.sleep(LOOP_SECONDS)
+
+
+if __name__ == '__main__':
+    main()

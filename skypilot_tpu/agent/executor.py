@@ -1,0 +1,269 @@
+"""Gang executor: runs one job across every TPU host, all-or-nothing.
+
+This replaces the reference's Ray placement-group machinery (RayCodeGen +
+STRICT_SPREAD pg + `ray job submit`, cloud_vm_ray_backend.py:221-710). On
+TPU the gang is *given* — a pod slice is atomic — so the executor is a small
+head-node fan-out: one process per host via CommandRunner, rank = (node,
+TPU worker id), kill-all-on-any-failure (the reference's `get_or_fail`
+semantics at :314-350), per-rank log files streamed back to the head.
+
+Run as `python -m skypilot_tpu.agent.executor <job_id>` — detached by the
+submit path; claims its FIFO turn from job_lib, then drives the gang.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+from skypilot_tpu.agent import job_lib
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+
+
+def _load_cluster_info() -> common.ClusterInfo:
+    path = os.path.expanduser(constants.CLUSTER_INFO)
+    with open(path) as f:
+        return common.ClusterInfo.from_dict(json.load(f))
+
+
+def build_host_env(cluster: common.ClusterInfo, host: common.InstanceInfo,
+                   num_nodes: int, hosts_per_node: int,
+                   chips_per_host: int, task_id: str,
+                   user_envs: Dict[str, str]) -> Dict[str, str]:
+    """The rendezvous env for one host process. See agent/constants.py."""
+    hosts = cluster.sorted_instances()
+    node_ips = [h.internal_ip for h in hosts if h.host_index == 0]
+    global_rank = host.node_index * hosts_per_node + host.host_index
+    coordinator = f'{hosts[0].internal_ip}:{constants.JAX_COORDINATOR_PORT}'
+    env = dict(user_envs)
+    env.update({
+        constants.ENV_NODE_RANK: str(host.node_index),
+        constants.ENV_NODE_IPS: '\n'.join(node_ips),
+        constants.ENV_NUM_NODES: str(num_nodes),
+        constants.ENV_HOST_RANK: str(host.host_index),
+        constants.ENV_NUM_HOSTS_PER_NODE: str(hosts_per_node),
+        constants.ENV_PROCESS_ID: str(global_rank),
+        constants.ENV_NUM_PROCESSES: str(len(hosts)),
+        constants.ENV_COORDINATOR: coordinator,
+        constants.ENV_TASK_ID: task_id,
+        constants.ENV_CHIPS_PER_HOST: str(chips_per_host),
+    })
+    if num_nodes > 1:
+        env.update({
+            constants.ENV_MEGASCALE_COORDINATOR:
+                f'{hosts[0].internal_ip}:{constants.MEGASCALE_PORT}',
+            constants.ENV_MEGASCALE_NUM_SLICES: str(num_nodes),
+            constants.ENV_MEGASCALE_SLICE_ID: str(host.node_index),
+        })
+    for alias, canonical in constants.COMPAT_ALIASES.items():
+        env[alias] = env[canonical]
+    return env
+
+
+class _HostRun:
+    """One host's process for one phase (setup or run)."""
+
+    def __init__(self, host: common.InstanceInfo, rank: int,
+                 runner: command_runner.CommandRunner):
+        self.host = host
+        self.rank = rank
+        self.runner = runner
+        self.returncode: Optional[int] = None
+        self.thread: Optional[threading.Thread] = None
+
+
+class GangExecutor:
+
+    def __init__(self, job_id: int):
+        self.job_id = job_id
+        job = job_lib.get_job(job_id)
+        assert job is not None, f'job {job_id} not in queue'
+        self.spec = job['spec']
+        self.cluster = _load_cluster_info()
+        self.hosts = self.cluster.sorted_instances()
+        self.num_nodes = int(self.spec['num_nodes'])
+        self.hosts_per_node = int(self.spec['hosts_per_node'])
+        self.chips_per_host = int(self.spec.get('chips_per_host', 0))
+        self.log_dir = job_lib.log_dir(job_id)
+        self._kill_lock = threading.Lock()
+        self._killed = False
+        expected = self.num_nodes * self.hosts_per_node
+        assert len(self.hosts) == expected, (
+            f'cluster has {len(self.hosts)} hosts, job wants {expected}')
+
+    # ------------------------------------------------------------------ #
+
+    def _pid_file(self, rank: int, phase: str) -> str:
+        return f'~/.skyt_agent/jobs/{self.job_id}/{phase}-rank{rank}.pid'
+
+    def _wrap(self, script_path: str, rank: int, phase: str) -> str:
+        """Run the script in its own session and record the pgid so cancel
+        can kill the whole process tree (reference analog:
+        skylet/subprocess_daemon.py)."""
+        pid_file = self._pid_file(rank, phase)
+        return (f'mkdir -p $(dirname {pid_file}); '
+                f'setsid bash {script_path} < /dev/null & pid=$!; '
+                f'echo $pid > {pid_file}; '
+                f'wait $pid')
+
+    def _stage_job(self) -> None:
+        """Copy the job dir (scripts) from head to every worker host — the
+        submit path only lands it on the head."""
+        src = job_lib.job_dir(self.job_id)
+        for host in self.hosts[1:]:
+            runner = command_runner.runner_from_spec(host.runner_spec)
+            runner.rsync(src + '/',
+                         f'~/.skyt_agent/jobs/{self.job_id}/', up=True)
+
+    def _script_for(self, phase: str, host: common.InstanceInfo) -> str:
+        if phase == 'setup':
+            return 'setup.sh'
+        if self.spec.get('per_node_run'):
+            return f'run-node{host.node_index}.sh'
+        return 'run.sh'
+
+    def _run_phase(self, phase: str,
+                   envs: Dict[str, str]) -> List[_HostRun]:
+        """Start the phase script on every host; wait all-or-nothing."""
+        runs = []
+        for rank, host in enumerate(self.hosts):
+            runner = command_runner.runner_from_spec(host.runner_spec)
+            runs.append(_HostRun(host, rank, runner))
+
+        def _one(run: _HostRun):
+            env = build_host_env(
+                self.cluster, run.host, self.num_nodes, self.hosts_per_node,
+                self.chips_per_host, self.spec['task_id'],
+                self.spec.get('envs', {}))
+            log_path = os.path.join(self.log_dir,
+                                    f'{phase}-rank{run.rank}.log')
+            script_name = self._script_for(phase, run.host)
+            script = f'~/.skyt_agent/jobs/{self.job_id}/{script_name}'
+            cmd = self._wrap(script, run.rank, phase)
+            try:
+                run.returncode = run.runner.run(cmd, env=env,
+                                                log_path=log_path)
+            except Exception as e:  # noqa: BLE001 — record, don't hang gang
+                with open(log_path, 'a') as f:
+                    f.write(f'\n[executor] host driver error: {e}\n')
+                run.returncode = 255
+            if run.returncode != 0:
+                self._kill_gang(runs, phase,
+                                failed_rank=run.rank,
+                                failed_rc=run.returncode)
+
+        for run in runs:
+            t = threading.Thread(target=_one, args=(run,), daemon=True)
+            run.thread = t
+            t.start()
+        for run in runs:
+            run.thread.join()
+        return runs
+
+    def _kill_gang(self, runs: List[_HostRun], phase: str,
+                   failed_rank: int, failed_rc: int) -> None:
+        """Any host failing kills every other host's process tree."""
+        with self._kill_lock:
+            if self._killed:
+                return
+            self._killed = True
+        with open(os.path.join(self.log_dir, 'driver.log'), 'a') as f:
+            f.write(f'[executor] rank {failed_rank} exited rc={failed_rc} '
+                    f'in phase {phase}; terminating the gang.\n')
+            if failed_rc == 139:
+                f.write('[executor] rc=139 is a segfault — on TPU VMs this '
+                        'often means another process holds the TPU chips.\n')
+        self.kill_all(runs_hint=runs, phase=phase)
+
+    def kill_all(self, runs_hint: Optional[List[_HostRun]] = None,
+                 phase: Optional[str] = None) -> None:
+        phases = [phase] if phase else ['setup', 'run']
+        for rank, host in enumerate(self.hosts):
+            runner = command_runner.runner_from_spec(host.runner_spec)
+            for ph in phases:
+                pid_file = self._pid_file(rank, ph)
+                cmd = (f'[ -f {pid_file} ] && pid=$(cat {pid_file}) && '
+                       f'kill -TERM -- -$pid 2>/dev/null; true')
+                try:
+                    runner.run(cmd, timeout=20)
+                except Exception:  # noqa: BLE001 — best effort
+                    pass
+
+    # ------------------------------------------------------------------ #
+
+    def execute(self) -> job_lib.JobStatus:
+        # FIFO turn: poll until we win the claim.
+        while not job_lib.try_start(self.job_id):
+            job = job_lib.get_job(self.job_id)
+            if job is None or job['status'].is_terminal():
+                return job['status'] if job else job_lib.JobStatus.CANCELLED
+            time.sleep(1)
+
+        job_lib.set_executor_pid(self.job_id, os.getpid())
+        envs = self.spec.get('envs', {})
+        self._stage_job()
+
+        if self.spec.get('has_setup'):
+            runs = self._run_phase('setup', envs)
+            if any(r.returncode != 0 for r in runs):
+                job_lib.set_status(self.job_id,
+                                   job_lib.JobStatus.FAILED_SETUP)
+                return job_lib.JobStatus.FAILED_SETUP
+
+        job_lib.set_status(self.job_id, job_lib.JobStatus.RUNNING)
+        if self.spec.get('has_run'):
+            self._killed = False
+            runs = self._run_phase('run', envs)
+            if self._cancelled():
+                return job_lib.JobStatus.CANCELLED
+            if any(r.returncode != 0 for r in runs):
+                job_lib.set_status(self.job_id, job_lib.JobStatus.FAILED)
+                return job_lib.JobStatus.FAILED
+        job_lib.set_status(self.job_id, job_lib.JobStatus.SUCCEEDED)
+        return job_lib.JobStatus.SUCCEEDED
+
+    def _cancelled(self) -> bool:
+        job = job_lib.get_job(self.job_id)
+        return job is not None and job['status'] == job_lib.JobStatus.CANCELLED
+
+
+def spawn_detached(job_id: int) -> None:
+    """Launch the executor as a daemonized process surviving the submit
+    SSH session (reference analog: `ray job submit` detachment)."""
+    subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.agent.executor', str(job_id)],
+        stdout=open(os.path.join(job_lib.log_dir(job_id), 'driver.log'),
+                    'ab'),
+        stderr=subprocess.STDOUT,
+        stdin=subprocess.DEVNULL,
+        start_new_session=True,
+        env={**os.environ,
+             'PYTHONPATH': os.path.expanduser(constants.RUNTIME_DIR) +
+             os.pathsep + os.environ.get('PYTHONPATH', '')})
+
+
+def main() -> None:
+    job_id = int(sys.argv[1])
+    executor = GangExecutor(job_id)
+
+    def _on_term(signum, frame):  # cancel path
+        del signum, frame
+        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED)
+        executor.kill_all()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    status = executor.execute()
+    sys.exit(0 if status == job_lib.JobStatus.SUCCEEDED else 1)
+
+
+if __name__ == '__main__':
+    main()

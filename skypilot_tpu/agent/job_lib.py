@@ -1,0 +1,162 @@
+"""On-head job queue (reference: sky/skylet/job_lib.py, 1068 LoC).
+
+SQLite at ~/.skyt_agent/jobs.db on the head host. The scheduler is FIFO
+one-at-a-time: a TPU slice is an exclusive resource, so concurrent jobs on
+one cluster would fight over the chips anyway (the reference schedules by
+accelerator demand; demand on a TPU cluster is always "all of it").
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.agent import constants
+
+
+class JobStatus(enum.Enum):
+    """Lifecycle (reference: job_lib.py:118): INIT -> PENDING ->
+    SETTING_UP -> RUNNING -> terminal."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                        JobStatus.FAILED_SETUP, JobStatus.CANCELLED)
+
+
+_TERMINAL = [s.value for s in JobStatus if s.is_terminal()]
+
+
+def _db_path() -> str:
+    path = os.path.expanduser(constants.JOBS_DB)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            status TEXT,
+            executor_pid INTEGER,
+            spec TEXT)
+    """)
+    return conn
+
+
+def add_job(name: str, spec: Dict[str, Any]) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, submitted_at, status, spec) '
+            'VALUES (?,?,?,?)',
+            (name, time.time(), JobStatus.PENDING.value, json.dumps(spec)))
+        return cur.lastrowid
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT job_id, name, submitted_at, started_at, ended_at,'
+            ' status, executor_pid, spec FROM jobs WHERE job_id=?',
+            (job_id,)).fetchone()
+    return _row(row) if row else None
+
+
+def get_jobs(limit: int = 100) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT job_id, name, submitted_at, started_at, ended_at,'
+            ' status, executor_pid, spec FROM jobs '
+            'ORDER BY job_id DESC LIMIT ?', (limit,)).fetchall()
+    return [_row(r) for r in rows]
+
+
+def _row(row) -> Dict[str, Any]:
+    return {'job_id': row[0], 'name': row[1], 'submitted_at': row[2],
+            'started_at': row[3], 'ended_at': row[4],
+            'status': JobStatus(row[5]), 'executor_pid': row[6],
+            'spec': json.loads(row[7]) if row[7] else {}}
+
+
+def set_status(job_id: int, status: JobStatus) -> None:
+    with _conn() as conn:
+        if status == JobStatus.RUNNING:
+            conn.execute('UPDATE jobs SET status=?, started_at=? '
+                         'WHERE job_id=?',
+                         (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE jobs SET status=?, ended_at=? WHERE job_id=? '
+                'AND status NOT IN (%s)' % ','.join('?' * len(_TERMINAL)),
+                (status.value, time.time(), job_id, *_TERMINAL))
+        else:
+            conn.execute('UPDATE jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+
+
+def set_executor_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE jobs SET executor_pid=? WHERE job_id=?',
+                     (pid, job_id))
+
+
+def try_start(job_id: int) -> bool:
+    """Atomically claim the FIFO head: succeed iff `job_id` is the oldest
+    PENDING job and nothing is SETTING_UP/RUNNING (reference analog:
+    FIFOScheduler, job_lib.py:266)."""
+    with _conn() as conn:
+        cur = conn.execute(
+            "UPDATE jobs SET status='SETTING_UP' WHERE job_id=? "
+            "AND status='PENDING' "
+            "AND NOT EXISTS (SELECT 1 FROM jobs WHERE status IN "
+            "  ('SETTING_UP','RUNNING')) "
+            "AND job_id=(SELECT MIN(job_id) FROM jobs "
+            "  WHERE status='PENDING')",
+            (job_id,))
+        return cur.rowcount == 1
+
+
+def is_idle() -> bool:
+    """No PENDING/SETTING_UP/RUNNING jobs (reference: job_lib.py:717
+    is_cluster_idle — feeds autostop)."""
+    with _conn() as conn:
+        row = conn.execute(
+            "SELECT COUNT(*) FROM jobs WHERE status IN "
+            "('PENDING','SETTING_UP','RUNNING')").fetchone()
+    return row[0] == 0
+
+
+def last_activity_time() -> float:
+    """Most recent job submission/end time, for autostop idle accounting."""
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(MAX(COALESCE(ended_at,0)),'
+            ' MAX(COALESCE(submitted_at,0))) FROM jobs').fetchone()
+    return row[0] or 0.0
+
+
+def job_dir(job_id: int) -> str:
+    d = os.path.expanduser(f'{constants.JOBS_DIR}/{job_id}')
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def log_dir(job_id: int) -> str:
+    d = os.path.expanduser(f'{constants.LOGS_DIR}/{job_id}')
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,51 @@
+"""SSH keypair management (reference: sky/authentication.py:107).
+
+One framework keypair under SKYT_HOME/keys; injected into VMs at provision
+time (GCP: metadata ssh-keys) and onto the head for head->worker fan-out.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import config as config_lib
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Returns (private_key_path, public_key_path)."""
+    key_dir = config_lib.home_dir() / 'keys'
+    key_dir.mkdir(parents=True, exist_ok=True, mode=0o700)
+    private = key_dir / 'skyt-key'
+    public = key_dir / 'skyt-key.pub'
+    if not private.exists():
+        try:
+            subprocess.run(
+                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f',
+                 str(private), '-C', 'skypilot-tpu'],
+                check=True)
+        except FileNotFoundError:
+            _generate_keys_python(private, public)
+        os.chmod(private, 0o600)
+    return str(private), str(public)
+
+
+def _generate_keys_python(private, public) -> None:
+    """ssh-keygen-free fallback via the `cryptography` package; if that is
+    also absent (fake-cloud-only environments never open an SSH
+    connection), write placeholder files so paths exist."""
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+        key = ed25519.Ed25519PrivateKey.generate()
+        private.write_bytes(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.OpenSSH,
+            serialization.NoEncryption()))
+        pub = key.public_key().public_bytes(
+            serialization.Encoding.OpenSSH,
+            serialization.PublicFormat.OpenSSH)
+        public.write_bytes(pub + b' skypilot-tpu\n')
+    except ImportError:
+        private.write_text('# no ssh-keygen/cryptography available\n')
+        public.write_text('# no ssh-keygen/cryptography available\n')

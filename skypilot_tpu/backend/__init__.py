@@ -1,0 +1,4 @@
+from skypilot_tpu.backend.cloud_tpu_backend import (ClusterHandle,
+                                                    CloudTpuBackend)
+
+__all__ = ['ClusterHandle', 'CloudTpuBackend']

@@ -1,0 +1,325 @@
+"""The backend: provision -> sync -> setup -> exec -> logs on TPU clusters.
+
+Reference equivalent: sky/backends/cloud_vm_ray_backend.py (5110 LoC). The
+structural difference is §7 of SURVEY.md: no Ray. The gang is executed by
+the on-head agent (skypilot_tpu/agent/), jobs are queued in the head's
+SQLite, and the client talks to the head over a stable agent CLI instead of
+string-codegen'd python snippets.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shlex
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    """Pickled per-cluster record in the client state DB (reference:
+    CloudVmRayResourceHandle, cloud_vm_ray_backend.py:2157-2620)."""
+    cluster_name: str
+    cloud: str
+    launched_nodes: int
+    launched_resources: resources_lib.Resources
+    cluster_info: provision_common.ClusterInfo
+
+    @property
+    def num_hosts_per_node(self) -> int:
+        """Reference: num_ips_per_node (:2551-2558) — a pod slice is N ssh
+        targets."""
+        return self.launched_resources.num_hosts()
+
+    @property
+    def head_runner_spec(self) -> Dict[str, Any]:
+        return self.cluster_info.head_instance.runner_spec
+
+    def head_runner(self) -> command_runner.CommandRunner:
+        return command_runner.runner_from_spec(self.head_runner_spec)
+
+    def all_runners(self) -> List[command_runner.CommandRunner]:
+        return [command_runner.runner_from_spec(i.runner_spec)
+                for i in self.cluster_info.sorted_instances()]
+
+    def __str__(self) -> str:
+        return (f'{self.cluster_name} ({self.launched_nodes}x '
+                f'{self.launched_resources})')
+
+
+def _agent_cmd(subcmd: str) -> str:
+    return (f'PYTHONPATH={agent_constants.RUNTIME_DIR} '
+            f'python3 -m skypilot_tpu.agent.cli {subcmd}')
+
+
+def _parse_agent_json(out: str) -> Any:
+    for line in out.splitlines():
+        if line.startswith('SKYT_JSON: '):
+            return json.loads(line[len('SKYT_JSON: '):])
+    raise exceptions.CommandError(1, 'agent', f'No agent JSON in: {out[:500]}')
+
+
+class CloudTpuBackend:
+    """Implements the Backend contract (reference: backends/backend.py:30-146
+    — provision / sync_workdir / sync_file_mounts / setup / execute /
+    teardown)."""
+
+    # ------------------------------------------------------------------ #
+    # Provision
+    # ------------------------------------------------------------------ #
+
+    @timeline.event
+    def provision(self, task: task_lib.Task, cluster_name: str,
+                  candidates: List[Any],
+                  dryrun: bool = False) -> Optional[ClusterHandle]:
+        res = task.best_resources or task.resources
+        if not res.is_launchable:
+            raise exceptions.ResourcesMismatchError(
+                f'Resources not launchable: {res}. Run the optimizer first.')
+        if dryrun:
+            logger.info(f'[dryrun] would provision {cluster_name}: '
+                        f'{task.num_nodes}x {res}')
+            return None
+        existing = global_user_state.get_cluster(cluster_name)
+        if existing is not None and existing['handle'] is not None:
+            handle = existing['handle']
+            if existing['status'] == global_user_state.ClusterStatus.UP:
+                self._check_task_fits(task, handle)
+                logger.info(f'Reusing existing cluster {cluster_name!r}.')
+                return handle
+            # STOPPED/INIT -> re-run provisioning (resume path).
+        result = provisioner.provision_with_failover(
+            cluster_name=cluster_name, cloud=res.cloud, resources=res,
+            num_nodes=task.num_nodes, candidates=candidates,
+            ports=list(res.ports))
+        handle = ClusterHandle(
+            cluster_name=cluster_name, cloud=res.cloud,
+            launched_nodes=task.num_nodes,
+            launched_resources=result.resources,
+            cluster_info=result.cluster_info)
+        global_user_state.add_or_update_cluster(
+            cluster_name, handle, global_user_state.ClusterStatus.INIT,
+            is_launch=True)
+        provisioner.wait_for_connectivity(result.cluster_info)
+        provisioner.setup_runtime_on_cluster(result.cluster_info)
+        provisioner.start_agent_daemon(result.cluster_info)
+        global_user_state.set_cluster_status(
+            cluster_name, global_user_state.ClusterStatus.UP)
+        logger.info(f'Cluster {cluster_name!r} is UP '
+                    f'({result.cluster_info.num_hosts} hosts in '
+                    f'{result.cluster_info.zone}).')
+        return handle
+
+    def _check_task_fits(self, task: task_lib.Task,
+                         handle: ClusterHandle) -> None:
+        res = task.resources
+        if not res.less_demanding_than(handle.launched_resources):
+            raise exceptions.ResourcesMismatchError(
+                f'Task requires {res}, but cluster {handle.cluster_name!r} '
+                f'has {handle.launched_resources}.')
+        if task.num_nodes > handle.launched_nodes:
+            raise exceptions.ResourcesMismatchError(
+                f'Task wants {task.num_nodes} nodes; cluster has '
+                f'{handle.launched_nodes}.')
+
+    # ------------------------------------------------------------------ #
+    # Sync + setup
+    # ------------------------------------------------------------------ #
+
+    @timeline.event
+    def sync_workdir(self, handle: ClusterHandle, workdir: str) -> None:
+        """rsync the workdir to every host in parallel (reference:
+        _sync_workdir :3138)."""
+
+        def _sync(runner: command_runner.CommandRunner) -> None:
+            runner.rsync(workdir.rstrip('/') + '/',
+                         agent_constants.WORKDIR + '/', up=True)
+
+        subprocess_utils.run_in_parallel(_sync, handle.all_runners())
+
+    @timeline.event
+    def sync_file_mounts(self, handle: ClusterHandle,
+                         file_mounts: Dict[str, str]) -> None:
+        """dst-on-cluster <- src (local path or gs:// URI), all hosts
+        (reference: _sync_file_mounts :3197)."""
+        if not file_mounts:
+            return
+        runners = handle.all_runners()
+        for dst, src in file_mounts.items():
+            if src.startswith('gs://'):
+                cmd = (f'mkdir -p $(dirname {dst}) && '
+                       f'gsutil -m rsync -r {shlex.quote(src)} '
+                       f'{shlex.quote(dst)}')
+                subprocess_utils.run_in_parallel(
+                    lambda r, c=cmd: r.run(c, check=True), runners)
+            else:
+                src_path = os.path.expanduser(src)
+                if not os.path.exists(src_path):
+                    raise exceptions.InvalidTaskError(
+                        f'file_mounts source not found: {src}')
+                if os.path.isdir(src_path):
+                    src_path = src_path.rstrip('/') + '/'
+
+                def _sync(r, s=src_path, d=dst):
+                    r.rsync(s, d, up=True)
+
+                subprocess_utils.run_in_parallel(_sync, runners)
+
+    # ------------------------------------------------------------------ #
+    # Execute
+    # ------------------------------------------------------------------ #
+
+    @timeline.event
+    def execute(self, handle: ClusterHandle, task: task_lib.Task,
+                detach_run: bool = False) -> int:
+        """Stage job scripts on the head, submit to the agent queue, then
+        (unless detached) stream logs (reference: _execute + RayCodeGen +
+        _exec_code_on_head, :3359-3538)."""
+        task_id = f'skyt-{time.strftime("%Y%m%d-%H%M%S")}-{uuid.uuid4().hex[:6]}'
+        num_nodes = task.num_nodes
+        hosts_per_node = handle.num_hosts_per_node
+        node_ips = [i.internal_ip
+                    for i in handle.cluster_info.sorted_instances()
+                    if i.host_index == 0]
+
+        per_node_run = callable(task.run)
+        spec = {
+            'name': task.name or '-',
+            'task_id': task_id,
+            'num_nodes': num_nodes,
+            'hosts_per_node': hosts_per_node,
+            'chips_per_host': (task.resources.tpu.chips_per_host
+                               if task.resources.tpu else 0),
+            'envs': dict(task.envs),
+            'has_setup': bool(task.setup),
+            'has_run': task.run is not None,
+            'per_node_run': per_node_run,
+        }
+        with tempfile.TemporaryDirectory() as td:
+            with open(os.path.join(td, 'job.json'), 'w') as f:
+                json.dump(spec, f)
+            preamble = ('set -e\n'
+                        f'[ -d {agent_constants.WORKDIR} ] && '
+                        f'cd {agent_constants.WORKDIR}\n')
+            if task.setup:
+                with open(os.path.join(td, 'setup.sh'), 'w') as f:
+                    f.write(preamble + task.setup + '\n')
+            if task.run is not None:
+                if per_node_run:
+                    for rank in range(num_nodes):
+                        cmd = task.get_command(rank, node_ips)
+                        with open(os.path.join(td, f'run-node{rank}.sh'),
+                                  'w') as f:
+                            f.write(preamble + (cmd or 'true') + '\n')
+                else:
+                    with open(os.path.join(td, 'run.sh'), 'w') as f:
+                        f.write(preamble + task.run + '\n')
+            staging = f'{agent_constants.AGENT_HOME}/staging/{task_id}'
+            head = handle.head_runner()
+            head.run(f'mkdir -p {staging}', check=True)
+            head.rsync(td + '/', staging + '/', up=True)
+            rc, out, err = head.run(
+                _agent_cmd(f'submit --job-file {staging}/job.json'),
+                require_outputs=True)
+            if rc != 0:
+                raise exceptions.CommandError(rc, 'agent submit', err or out)
+            job_id = _parse_agent_json(out)['job_id']
+        logger.info(f'Job submitted with ID {job_id} (task id {task_id}).')
+        if not detach_run:
+            self.tail_logs(handle, job_id)
+        return job_id
+
+    # ------------------------------------------------------------------ #
+    # Job ops (client -> head agent)
+    # ------------------------------------------------------------------ #
+
+    def tail_logs(self, handle: ClusterHandle, job_id: int,
+                  follow: bool = True) -> int:
+        flag = '--follow' if follow else '--no-follow'
+        return handle.head_runner().run(
+            _agent_cmd(f'tail {job_id} {flag}'), stream_logs=True)
+
+    def get_job_queue(self, handle: ClusterHandle) -> List[Dict[str, Any]]:
+        rc, out, err = handle.head_runner().run(
+            _agent_cmd('queue'), require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'agent queue', err or out)
+        return _parse_agent_json(out)
+
+    def get_job_status(self, handle: ClusterHandle,
+                       job_id: int) -> Optional[str]:
+        rc, out, err = handle.head_runner().run(
+            _agent_cmd(f'status {job_id}'), require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'agent status', err or out)
+        result = _parse_agent_json(out)
+        return None if result is None else result['status']
+
+    def cancel_jobs(self, handle: ClusterHandle,
+                    job_id: Optional[int] = None) -> List[int]:
+        target = 'all' if job_id is None else str(job_id)
+        rc, out, err = handle.head_runner().run(
+            _agent_cmd(f'cancel {target}'), require_outputs=True)
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'agent cancel', err or out)
+        return _parse_agent_json(out)['cancelled']
+
+    def sync_down_logs(self, handle: ClusterHandle, job_id: int,
+                       local_dir: str) -> str:
+        """Pull a job's log dir to the client (reference: sync_down_logs
+        :3752)."""
+        os.makedirs(local_dir, exist_ok=True)
+        handle.head_runner().rsync(
+            f'{agent_constants.LOGS_DIR}/{job_id}/', local_dir + '/',
+            up=False)
+        return local_dir
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle
+    # ------------------------------------------------------------------ #
+
+    def set_autostop(self, handle: ClusterHandle, idle_minutes: int,
+                     down: bool = False) -> None:
+        """Reference: set_autostop :4396. Pods can only autodown."""
+        if handle.launched_resources.num_hosts() > 1 and not down \
+                and idle_minutes >= 0:
+            raise exceptions.NotSupportedError(
+                'TPU pod slices cannot stop; use autostop with down=True.')
+        cfg = json.dumps({'idle_minutes': idle_minutes, 'down': down})
+        handle.head_runner().run(
+            f'mkdir -p {agent_constants.AGENT_HOME} && '
+            f"echo {shlex.quote(cfg)} > {agent_constants.AUTOSTOP_CONFIG}",
+            check=True)
+        global_user_state.set_cluster_autostop(handle.cluster_name,
+                                               idle_minutes, down)
+
+    def stop(self, handle: ClusterHandle) -> None:
+        if handle.launched_resources.num_hosts() > 1:
+            raise exceptions.NotSupportedError(
+                'TPU pod slices cannot be stopped (no per-host disks to '
+                'preserve); use down instead.')
+        provision.stop_instances(handle.cloud, handle.cluster_name)
+        global_user_state.set_cluster_status(
+            handle.cluster_name, global_user_state.ClusterStatus.STOPPED)
+
+    def teardown(self, handle: ClusterHandle) -> None:
+        provision.terminate_instances(handle.cloud, handle.cluster_name)
+        global_user_state.remove_cluster(handle.cluster_name)

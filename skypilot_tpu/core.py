@@ -1,0 +1,136 @@
+"""Non-launch verbs: status/start/stop/down/queue/cancel/logs/autostop/
+cost-report (reference: sky/core.py, 925 LoC)."""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backend import CloudTpuBackend, ClusterHandle
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _get_handle(cluster_name: str) -> ClusterHandle:
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None or record['handle'] is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist.')
+    return record['handle']
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile DB state with the cloud (reference:
+    _update_cluster_status_no_lock, backend_utils.py:1929 + the state
+    machine in design_docs/cluster_status.md):
+      * all instances RUNNING -> keep/mark UP
+      * any STOPPED           -> STOPPED (whole cluster must be stopped)
+      * none found            -> cluster is gone; drop the record
+    """
+    handle: Optional[ClusterHandle] = record['handle']
+    if handle is None:
+        return record
+    name = record['name']
+    try:
+        statuses = provision.query_instances(handle.cloud, name)
+    except Exception as e:  # noqa: BLE001 — cloud probe failed; keep as-is
+        logger.debug(f'status refresh failed for {name}: {e}')
+        return record
+    if not statuses:
+        global_user_state.remove_cluster(name)
+        record = dict(record)
+        record['status'] = None
+        return record
+    values = set(statuses.values())
+    if values == {provision_common.InstanceStatus.RUNNING}:
+        new_status = global_user_state.ClusterStatus.UP
+    elif provision_common.InstanceStatus.STOPPED in values:
+        new_status = global_user_state.ClusterStatus.STOPPED
+    else:
+        new_status = global_user_state.ClusterStatus.INIT
+    if new_status != record['status']:
+        global_user_state.set_cluster_status(name, new_status)
+        record = dict(record)
+        record['status'] = new_status
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    """Cluster table (reference: core.status / `sky status -r`)."""
+    records = global_user_state.get_clusters()
+    if cluster_names is not None:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        records = [_refresh_one(r) for r in records]
+        records = [r for r in records if r['status'] is not None]
+    return records
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster (reference: core.start — `sky start`)."""
+    handle = _get_handle(cluster_name)
+    record = global_user_state.get_cluster(cluster_name)
+    if record['status'] == global_user_state.ClusterStatus.UP:
+        logger.info(f'Cluster {cluster_name!r} is already UP.')
+        return
+    res = handle.launched_resources
+    offerings = res.get_offerings()
+    result = provisioner.provision_with_failover(
+        cluster_name=cluster_name, cloud=handle.cloud, resources=res,
+        num_nodes=handle.launched_nodes, candidates=offerings)
+    handle.cluster_info = result.cluster_info
+    global_user_state.add_or_update_cluster(
+        cluster_name, handle, global_user_state.ClusterStatus.INIT,
+        is_launch=True)
+    provisioner.wait_for_connectivity(result.cluster_info)
+    provisioner.setup_runtime_on_cluster(result.cluster_info)
+    provisioner.start_agent_daemon(result.cluster_info)
+    global_user_state.set_cluster_status(
+        cluster_name, global_user_state.ClusterStatus.UP)
+
+
+def stop(cluster_name: str) -> None:
+    CloudTpuBackend().stop(_get_handle(cluster_name))
+
+
+def down(cluster_name: str) -> None:
+    CloudTpuBackend().teardown(_get_handle(cluster_name))
+
+
+def autostop(cluster_name: str, idle_minutes: int,
+             down_after: bool = False) -> None:
+    CloudTpuBackend().set_autostop(_get_handle(cluster_name), idle_minutes,
+                                   down_after)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    return CloudTpuBackend().get_job_queue(_get_handle(cluster_name))
+
+
+def cancel(cluster_name: str,
+           job_id: Optional[int] = None) -> List[int]:
+    return CloudTpuBackend().cancel_jobs(_get_handle(cluster_name), job_id)
+
+
+def tail_logs(cluster_name: str, job_id: int, follow: bool = True) -> int:
+    return CloudTpuBackend().tail_logs(_get_handle(cluster_name), job_id,
+                                       follow)
+
+
+def download_logs(cluster_name: str, job_id: int, local_dir: str) -> str:
+    return CloudTpuBackend().sync_down_logs(_get_handle(cluster_name),
+                                            job_id, local_dir)
+
+
+def job_status(cluster_name: str, job_id: int) -> Optional[str]:
+    return CloudTpuBackend().get_job_status(_get_handle(cluster_name),
+                                            job_id)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return global_user_state.get_cost_report()

@@ -1,0 +1,144 @@
+"""Execution driver: the stage machine behind launch()/exec().
+
+Reference: sky/execution.py (642 LoC; Stage enum :31-41, _execute :95,
+launch :369). Stages: OPTIMIZE -> PROVISION -> SYNC_WORKDIR ->
+SYNC_FILE_MOUNTS -> SETUP(part of job) -> PRE_EXEC(autostop) -> EXEC ->
+DOWN(optional).
+"""
+from __future__ import annotations
+
+import enum
+import uuid
+from typing import List, Optional, Tuple, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import CloudTpuBackend, ClusterHandle
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    PRE_EXEC = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+ALL_STAGES = list(Stage)
+
+
+def _generate_cluster_name() -> str:
+    return f'skyt-{uuid.uuid4().hex[:8]}'
+
+
+@timeline.event
+def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
+             cluster_name: Optional[str],
+             stages: List[Stage],
+             dryrun: bool = False,
+             detach_run: bool = False,
+             optimize_target=optimizer_lib.OptimizeTarget.COST,
+             down: bool = False,
+             quiet_optimizer: bool = False
+             ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    dag = dag_lib.to_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        # Chains are a managed-jobs concern (reference asserts the same,
+        # execution.py:180).
+        raise exceptions.NotSupportedError(
+            'launch/exec take a single task; use managed jobs for chains.')
+    task = dag.tasks[0]
+    if cluster_name is None:
+        cluster_name = _generate_cluster_name()
+
+    backend = CloudTpuBackend()
+    handle: Optional[ClusterHandle] = None
+    job_id: Optional[int] = None
+    candidates: List = []
+
+    if Stage.OPTIMIZE in stages:
+        # Reuse an existing UP cluster's resources instead of re-optimizing
+        # (exec path skips OPTIMIZE entirely; launch onto existing cluster
+        # keeps its concrete placement).
+        plan = optimizer_lib.optimize_task(task, optimize_target)
+        candidates = plan.candidates
+        if not quiet_optimizer and not dryrun:
+            print(optimizer_lib.format_plan_table([plan]))
+
+    if Stage.PROVISION in stages:
+        handle = backend.provision(task, cluster_name, candidates,
+                                   dryrun=dryrun)
+        if dryrun:
+            return None, None
+    else:
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None or record['handle'] is None:
+            raise exceptions.ClusterDoesNotExist(
+                f'Cluster {cluster_name!r} does not exist; launch it first.')
+        if record['status'] != global_user_state.ClusterStatus.UP:
+            raise exceptions.ClusterNotUpError(
+                f'Cluster {cluster_name!r} is {record["status"].value}.')
+        handle = record['handle']
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir:
+        logger.info(f'Syncing workdir {task.workdir} -> '
+                    f'{handle.cluster_name}...')
+        backend.sync_workdir(handle, task.workdir)
+
+    if Stage.SYNC_FILE_MOUNTS in stages and task.file_mounts:
+        backend.sync_file_mounts(handle, task.file_mounts)
+
+    if Stage.PRE_EXEC in stages:
+        res = task.best_resources or task.resources
+        if res.autostop_minutes is not None:
+            backend.set_autostop(handle, res.autostop_minutes,
+                                 res.autostop_down)
+
+    if Stage.EXEC in stages and (task.run is not None or task.setup):
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+
+    if Stage.DOWN in stages and down:
+        backend.teardown(handle)
+        handle = None
+
+    return job_id, handle
+
+
+def launch(task: Union[task_lib.Task, dag_lib.Dag],
+           cluster_name: Optional[str] = None,
+           dryrun: bool = False,
+           detach_run: bool = False,
+           down: bool = False,
+           quiet_optimizer: bool = False
+           ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Reference: sky.launch (execution.py:369). Returns (job_id, handle).
+    """
+    stages = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+              Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC, Stage.EXEC]
+    if down:
+        stages.append(Stage.DOWN)
+    return _execute(task, cluster_name, stages, dryrun=dryrun,
+                    detach_run=detach_run, down=down,
+                    quiet_optimizer=quiet_optimizer)
+
+
+def exec(task: Union[task_lib.Task, dag_lib.Dag],  # pylint: disable=redefined-builtin
+         cluster_name: str,
+         detach_run: bool = False
+         ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
+    """Fast path onto an existing cluster: sync + run, no provision
+    (reference: sky.exec, execution.py end; stages [SYNC_WORKDIR, EXEC])."""
+    return _execute(task, cluster_name,
+                    [Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS, Stage.EXEC],
+                    detach_run=detach_run)

@@ -109,8 +109,15 @@ def set_cluster_status(name: str, status: ClusterStatus) -> None:
     conn.execute('UPDATE clusters SET status=?, last_activity=? '
                  'WHERE name=?', (status.value, time.time(), name))
     conn.commit()
-    if status != ClusterStatus.UP:
+    # Cost accrual follows billable state: VMs bill while they exist and
+    # are not STOPPED. INIT (provisioning/unknown) keeps accruing; UP
+    # re-opens an interval a STOPPED period closed.
+    if status == ClusterStatus.STOPPED:
         _record_history_stop(name)
+    elif status == ClusterStatus.UP:
+        record = get_cluster(name)
+        if record is not None:
+            _record_history_start(name, record['handle'])
 
 
 def set_cluster_autostop(name: str, idle_minutes: int,
@@ -162,21 +169,25 @@ def _row_to_record(row) -> Dict[str, Any]:
 # --------------------------------------------------------------------- #
 
 def _record_history_start(name: str, handle: Any) -> None:
+    """Open a usage interval. Each interval carries the hourly price in
+    effect when it opened, so relaunching the same cluster name on pricier
+    resources doesn't re-price past usage."""
     conn = _conn()
     row = conn.execute(
         'SELECT usage_intervals FROM cluster_history WHERE cluster_name=?',
         (name,)).fetchone()
     intervals = pickle.loads(row[0]) if row and row[0] else []
-    # Re-launching onto a still-UP cluster must not open a second interval —
-    # get_cost_report treats an open interval as still-accruing.
-    if not intervals or intervals[-1][1] is not None:
-        intervals.append((time.time(), None))
     resources_str = str(getattr(handle, 'launched_resources', ''))
     num_nodes = getattr(handle, 'launched_nodes', 1)
     hourly = 0.0
     res = getattr(handle, 'launched_resources', None)
     if res is not None:
         hourly = (res.hourly_price() or 0.0) * num_nodes
+    # Re-launching onto a still-UP cluster must not open a second interval —
+    # get_cost_report treats an open interval as still-accruing.
+    if not intervals or intervals[-1]['end'] is not None:
+        intervals.append({'start': time.time(), 'end': None,
+                          'hourly_cost': hourly})
     conn.execute(
         'INSERT INTO cluster_history (cluster_name, usage_intervals,'
         ' resources_str, num_nodes, hourly_cost) VALUES (?,?,?,?,?)'
@@ -196,8 +207,8 @@ def _record_history_stop(name: str) -> None:
     if not row or not row[0]:
         return
     intervals = pickle.loads(row[0])
-    if intervals and intervals[-1][1] is None:
-        intervals[-1] = (intervals[-1][0], time.time())
+    if intervals and intervals[-1]['end'] is None:
+        intervals[-1]['end'] = time.time()
         conn.execute(
             'UPDATE cluster_history SET usage_intervals=? '
             'WHERE cluster_name=?', (pickle.dumps(intervals), name))
@@ -209,16 +220,21 @@ def get_cost_report() -> List[Dict[str, Any]]:
         'SELECT cluster_name, usage_intervals, resources_str, num_nodes,'
         ' hourly_cost FROM cluster_history').fetchall()
     report = []
-    for name, blob, res_str, num_nodes, hourly in rows:
+    now = time.time()
+    for name, blob, res_str, num_nodes, _ in rows:
         intervals = pickle.loads(blob) if blob else []
-        total_s = sum((end or time.time()) - start
-                      for start, end in intervals)
+        total_s = 0.0
+        cost = 0.0
+        for iv in intervals:
+            dur = (iv['end'] or now) - iv['start']
+            total_s += dur
+            cost += iv['hourly_cost'] * dur / 3600.0
         report.append({
             'name': name,
             'resources': res_str,
             'num_nodes': num_nodes,
             'duration_hours': total_s / 3600.0,
-            'cost': hourly * total_s / 3600.0,
+            'cost': cost,
         })
     return report
 

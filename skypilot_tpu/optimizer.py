@@ -94,6 +94,8 @@ def optimize(dag: dag_lib.Dag,
 def format_plan_table(plans: List[OptimizedPlan]) -> str:
     """Pretty plan table (reference prints via rich, optimizer.py:720)."""
     header = ['TASK', 'RESOURCES', 'ZONE', '$/HR', 'CANDIDATE ZONES']
+    if not plans:
+        return '(no tasks)'
     rows = []
     for p in plans:
         res = p.task.best_resources

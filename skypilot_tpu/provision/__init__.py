@@ -1,0 +1,48 @@
+"""Per-cloud provisioner dispatch (reference: sky/provision/__init__.py —
+the 11-function protocol at :65-204, dispatched by module name via
+`_route_to_cloud_impl`).
+
+Every cloud module under skypilot_tpu/provision/<cloud>/instance.py
+implements:
+    bootstrap_config(config) -> ProvisionConfig
+    run_instances(config) -> ProvisionRecord
+    wait_instances(region, cluster_name, state) -> None
+    stop_instances(cluster_name, provider_config) -> None
+    terminate_instances(cluster_name, provider_config) -> None
+    query_instances(cluster_name, provider_config) -> Dict[str, str]
+    get_cluster_info(region, cluster_name, provider_config) -> ClusterInfo
+    open_ports / cleanup_ports(cluster_name, ports, provider_config)
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any
+
+
+def _impl(cloud: str):
+    return importlib.import_module(f'skypilot_tpu.provision.{cloud}.instance')
+
+
+def _route(fn_name: str):
+    @functools.wraps(getattr(object, '__init__', None), ('__name__',))
+    def wrapper(cloud: str, *args: Any, **kwargs: Any) -> Any:
+        module = _impl(cloud)
+        fn = getattr(module, fn_name, None)
+        if fn is None:
+            raise NotImplementedError(
+                f'Cloud {cloud!r} does not implement {fn_name}')
+        return fn(*args, **kwargs)
+    wrapper.__name__ = fn_name
+    return wrapper
+
+
+bootstrap_config = _route('bootstrap_config')
+run_instances = _route('run_instances')
+wait_instances = _route('wait_instances')
+stop_instances = _route('stop_instances')
+terminate_instances = _route('terminate_instances')
+query_instances = _route('query_instances')
+get_cluster_info = _route('get_cluster_info')
+open_ports = _route('open_ports')
+cleanup_ports = _route('cleanup_ports')

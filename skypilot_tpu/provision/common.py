@@ -1,0 +1,111 @@
+"""Provisioner shared types (reference: sky/provision/common.py, 298 LoC)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import resources as resources_lib
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provider impl needs to create one cluster.
+
+    The reference renders a Jinja cluster YAML (backend_utils.py:691); we
+    pass a typed config and let the provider map it to API calls. One
+    `node` = one TPU slice (or one GCE VM for CPU clusters); a multi-host
+    slice fans out to many InstanceInfos at query time.
+    """
+    cluster_name: str
+    cloud: str
+    region: str
+    zone: str
+    num_nodes: int
+    resources: resources_lib.Resources
+    authentication: Dict[str, str]          # ssh_user / public/private key
+    ports: List[int] = dataclasses.field(default_factory=list)
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    """Result of run_instances (reference: common.py:63)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: str
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.resumed_instance_ids or
+                instance_id in self.created_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One SSH target (reference: common.py:92). A v5p-64 node yields 8 of
+    these — one per networkEndpoint (gcp/instance_utils.py:1635-1655)."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    node_index: int        # which slice/VM this host belongs to
+    host_index: int        # host rank within the slice (TPU worker id)
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # Serialized CommandRunner spec (utils/command_runner.runner_from_spec).
+    runner_spec: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Full cluster view returned by get_cluster_info (reference:
+    common.py:109-230)."""
+    provider_name: str
+    cluster_name: str
+    region: str
+    zone: str
+    instances: List[InstanceInfo]
+    ssh_user: str = ''
+
+    @property
+    def head_instance(self) -> InstanceInfo:
+        return self.sorted_instances()[0]
+
+    def sorted_instances(self) -> List[InstanceInfo]:
+        """Stable global host ordering: (node_index, host_index). This IS
+        the process-rank ordering for jax.distributed — not sorted-IP order
+        (the reference sorts IPs, cloud_vm_ray_backend.py:381-556, which is
+        wrong for TPU: rank must equal the TPU worker id)."""
+        return sorted(self.instances,
+                      key=lambda i: (i.node_index, i.host_index))
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.instances)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'provider_name': self.provider_name,
+            'cluster_name': self.cluster_name,
+            'region': self.region,
+            'zone': self.zone,
+            'ssh_user': self.ssh_user,
+            'instances': [dataclasses.asdict(i) for i in self.instances],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'ClusterInfo':
+        insts = [InstanceInfo(**i) for i in d['instances']]
+        return cls(provider_name=d['provider_name'],
+                   cluster_name=d['cluster_name'], region=d['region'],
+                   zone=d['zone'], instances=insts,
+                   ssh_user=d.get('ssh_user', ''))
+
+
+class InstanceStatus:
+    """Provider-level instance states (normalized)."""
+    PENDING = 'PENDING'
+    RUNNING = 'RUNNING'
+    STOPPED = 'STOPPED'
+    TERMINATED = 'TERMINATED'

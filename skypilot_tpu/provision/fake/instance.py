@@ -1,0 +1,220 @@
+"""Fake cloud: localhost directories impersonating TPU hosts.
+
+This is the in-repo test substrate the reference lacks (SURVEY.md §4: "no
+fake provisioner/in-memory cloud" — multi-node behavior there is only
+covered by real-cloud smoke tests). Every capability of the real provider
+protocol is modeled:
+
+  * a "node" is a TPU slice; a multi-host slice materializes as N host
+    directories, each reachable via LocalCommandRunner with HOME remapped —
+    so the gang executor, agent, and env contract run exactly as on real
+    pods, minus the network.
+  * capacity injection: `capacity.json` at the fake-cloud root can declare
+    per-zone remaining slices or region-level quota failure, driving the
+    failover engine in tests (the reference can only test failover against
+    live clouds).
+
+Layout under $SKYT_HOME/fake_cloud/:
+    capacity.json                      (optional, written by tests)
+    clusters/<name>/meta.json
+    clusters/<name>/node<i>-host<j>/   (one dir per host = one "VM")
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import shutil
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+
+PROVIDER_NAME = 'fake'
+
+
+def _root() -> pathlib.Path:
+    d = config_lib.home_dir() / 'fake_cloud'
+    (d / 'clusters').mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _cluster_dir(cluster_name: str) -> pathlib.Path:
+    return _root() / 'clusters' / cluster_name
+
+
+def _meta_path(cluster_name: str) -> pathlib.Path:
+    return _cluster_dir(cluster_name) / 'meta.json'
+
+
+def _load_meta(cluster_name: str) -> Optional[Dict[str, Any]]:
+    p = _meta_path(cluster_name)
+    if not p.exists():
+        return None
+    return json.loads(p.read_text())
+
+
+def _save_meta(cluster_name: str, meta: Dict[str, Any]) -> None:
+    _meta_path(cluster_name).write_text(json.dumps(meta, indent=2))
+
+
+# ------------------------------------------------------------------ #
+# Capacity injection for failover tests
+# ------------------------------------------------------------------ #
+
+def _capacity() -> Dict[str, Any]:
+    p = _root() / 'capacity.json'
+    if p.exists():
+        return json.loads(p.read_text())
+    return {}
+
+
+def set_capacity(zones: Optional[Dict[str, int]] = None,
+                 quota_fail_regions: Optional[List[str]] = None) -> None:
+    """Test hook: limit per-zone slice capacity / fail regions on quota."""
+    (_root() / 'capacity.json').write_text(json.dumps({
+        'zones': zones or {},
+        'quota_fail_regions': quota_fail_regions or [],
+    }))
+
+
+def _check_and_take_capacity(zone: str, region: str, n: int) -> None:
+    cap = _capacity()
+    if region in cap.get('quota_fail_regions', []):
+        raise exceptions.QuotaExceededError(
+            f'[fake] Quota QUOTA_EXCEEDED in region {region}')
+    zones = cap.get('zones')
+    if zones is None or zone not in (zones or {}):
+        return  # unlimited
+    remaining = zones[zone]
+    if remaining < n:
+        raise exceptions.TpuCapacityError(
+            f'[fake] There is no more capacity in the zone {zone!r}; '
+            f'requested {n}, have {remaining}.')
+    zones[zone] = remaining - n
+    (_root() / 'capacity.json').write_text(json.dumps(cap))
+
+
+# ------------------------------------------------------------------ #
+# Protocol implementation
+# ------------------------------------------------------------------ #
+
+def bootstrap_config(config: common.ProvisionConfig
+                     ) -> common.ProvisionConfig:
+    """No IAM/VPC to set up; identity function (reference analog:
+    gcp/config.py bootstrap_instances)."""
+    return config
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    meta = _load_meta(config.cluster_name)
+    res = config.resources
+    hosts_per_node = res.num_hosts()
+    created, resumed = [], []
+    if meta is None:
+        _check_and_take_capacity(config.zone, config.region,
+                                 config.num_nodes)
+        meta = {
+            'cluster_name': config.cluster_name,
+            'region': config.region,
+            'zone': config.zone,
+            'num_nodes': config.num_nodes,
+            'hosts_per_node': hosts_per_node,
+            'tpu_type': res.tpu.type_name if res.tpu else None,
+            'instance_type': res.instance_type,
+            'use_spot': res.use_spot,
+            'status': 'RUNNING',
+        }
+        for node in range(config.num_nodes):
+            for host in range(hosts_per_node):
+                iid = f'node{node}-host{host}'
+                (_cluster_dir(config.cluster_name) / iid).mkdir(
+                    parents=True, exist_ok=True)
+                created.append(iid)
+        _save_meta(config.cluster_name, meta)
+    else:
+        if meta['status'] == 'STOPPED':
+            meta['status'] = 'RUNNING'
+            _save_meta(config.cluster_name, meta)
+            resumed = [i.instance_id for i in _instances(meta)]
+    return common.ProvisionRecord(
+        provider_name=PROVIDER_NAME, cluster_name=config.cluster_name,
+        region=config.region, zone=config.zone,
+        resumed_instance_ids=resumed, created_instance_ids=created)
+
+
+def _instances(meta: Dict[str, Any]) -> List[common.InstanceInfo]:
+    out = []
+    name = meta['cluster_name']
+    for node in range(meta['num_nodes']):
+        for host in range(meta['hosts_per_node']):
+            iid = f'node{node}-host{host}'
+            host_dir = str(_cluster_dir(name) / iid)
+            # Deterministic fake internal IPs (per-node subnet).
+            ip = f'10.{(hash(name) % 200) + 10}.{node}.{host + 2}'
+            out.append(common.InstanceInfo(
+                instance_id=iid, internal_ip=ip, external_ip='127.0.0.1',
+                node_index=node, host_index=host,
+                runner_spec={'kind': 'local', 'host_dir': host_dir}))
+    return out
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None) -> None:
+    """Directories are instantly 'booted'."""
+    del region, cluster_name, state
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict] = None) -> None:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return
+    if meta['hosts_per_node'] > 1:
+        # Mirror real TPU semantics: pods cannot stop (gcp.py:193-197).
+        raise exceptions.NotSupportedError(
+            'TPU pod slices cannot be stopped; use down.')
+    meta['status'] = 'STOPPED'
+    _save_meta(cluster_name, meta)
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict] = None) -> None:
+    d = _cluster_dir(cluster_name)
+    if d.exists():
+        shutil.rmtree(d)
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict] = None
+                    ) -> Dict[str, str]:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return {}
+    status = (common.InstanceStatus.RUNNING
+              if meta['status'] == 'RUNNING'
+              else common.InstanceStatus.STOPPED)
+    return {i.instance_id: status for i in _instances(meta)}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict] = None
+                     ) -> common.ClusterInfo:
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    return common.ClusterInfo(
+        provider_name=PROVIDER_NAME, cluster_name=cluster_name,
+        region=meta['region'], zone=meta['zone'],
+        instances=_instances(meta), ssh_user=os.environ.get('USER', 'user'))
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               provider_config: Optional[Dict] = None) -> None:
+    del cluster_name, ports
+
+
+def cleanup_ports(cluster_name: str, ports: List[int],
+                  provider_config: Optional[Dict] = None) -> None:
+    del cluster_name, ports

@@ -1,0 +1,189 @@
+"""Provision orchestration: bulk_provision + runtime setup + failover.
+
+Reference equivalents: sky/provision/provisioner.py (bulk_provision :100,
+wait_for_ssh :216-392, _post_provision_setup :394) and the failover engine
+RetryingVmProvisioner (cloud_vm_ray_backend.py:1156-2156). The reference's
+failover parses provider stdout into blocklists
+(FailoverCloudErrorHandlerV1/V2); our providers raise typed ProvisionError
+with a FailoverScope, so the loop here is just: try a zone, blocklist at the
+error's scope, move to the next candidate. TPU stockouts (the common case)
+arrive as TpuCapacityError -> zone-scoped.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu import authentication
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+_PACKAGE_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@dataclasses.dataclass
+class ProvisionResult:
+    record: common.ProvisionRecord
+    cluster_info: common.ClusterInfo
+    resources: resources_lib.Resources   # concrete, zone-pinned
+
+
+@timeline.event
+def provision_with_failover(
+        cluster_name: str,
+        cloud: str,
+        resources: resources_lib.Resources,
+        num_nodes: int,
+        candidates: List,           # catalog offerings, price-ascending
+        ports: Optional[List[int]] = None) -> ProvisionResult:
+    """Try candidate zones in order, blocklisting at the scope each failure
+    names (reference: provision_with_retries, cloud_vm_ray_backend.py:1980).
+    """
+    private_key, public_key = authentication.get_or_generate_keys()
+    auth = {'ssh_user': os.environ.get('USER', 'skyt'),
+            'ssh_private_key': private_key,
+            'ssh_public_key': public_key}
+
+    blocked_zones: Set[str] = set()
+    blocked_regions: Set[str] = set()
+    failures: List[Exception] = []
+
+    for cand in candidates:
+        zone, region = cand.zone, cand.region
+        if zone in blocked_zones or region in blocked_regions:
+            continue
+        config = common.ProvisionConfig(
+            cluster_name=cluster_name, cloud=cloud, region=region,
+            zone=zone, num_nodes=num_nodes, resources=resources,
+            authentication=auth, ports=list(ports or []))
+        try:
+            logger.info(f'Provisioning {cluster_name!r} '
+                        f'({num_nodes}x {resources}) in {zone}...')
+            config = provision.bootstrap_config(cloud, config)
+            record = provision.run_instances(cloud, config)
+            provision.wait_instances(cloud, region, cluster_name,
+                                     common.InstanceStatus.RUNNING)
+            info = provision.get_cluster_info(cloud, region, cluster_name)
+            concrete = resources.copy(cloud=cloud, region=region, zone=zone)
+            return ProvisionResult(record=record, cluster_info=info,
+                                   resources=concrete)
+        except exceptions.ProvisionError as e:
+            failures.append(e)
+            logger.warning(f'  {zone}: {e}')
+            # Clean partial state before moving on.
+            try:
+                provision.terminate_instances(cloud, cluster_name)
+            except Exception:  # noqa: BLE001
+                pass
+            if e.scope == exceptions.FailoverScope.ZONE:
+                blocked_zones.add(zone)
+            elif e.scope == exceptions.FailoverScope.REGION:
+                blocked_regions.add(region)
+            else:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Cloud-level provisioning failure: {e}',
+                    failover_history=failures) from e
+    raise exceptions.ResourcesUnavailableError(
+        f'Failed to provision {cluster_name!r} in all candidate zones '
+        f'({len(failures)} attempts). Errors: '
+        + '; '.join(str(f) for f in failures[-3:]),
+        failover_history=failures)
+
+
+# --------------------------------------------------------------------- #
+# Post-provision runtime setup (reference: _post_provision_setup :394,
+# instance_setup.py internal_file_mounts/setup_runtime_on_cluster)
+# --------------------------------------------------------------------- #
+
+@timeline.event
+def wait_for_connectivity(info: common.ClusterInfo,
+                          timeout: float = 600) -> None:
+    """Block until every host answers (reference: wait_for_ssh :216)."""
+    deadline = time.time() + timeout
+
+    def _wait(host: common.InstanceInfo) -> None:
+        runner = command_runner.runner_from_spec(host.runner_spec)
+        while True:
+            try:
+                if runner.run('true', timeout=15) == 0:
+                    return
+            except Exception:  # noqa: BLE001
+                pass
+            if time.time() > deadline:
+                raise exceptions.ProvisionError(
+                    f'Host {host.instance_id} unreachable after '
+                    f'{timeout}s', scope=exceptions.FailoverScope.ZONE)
+            time.sleep(5)
+
+    subprocess_utils.run_in_parallel(_wait, info.sorted_instances())
+
+
+@timeline.event
+def setup_runtime_on_cluster(info: common.ClusterInfo) -> None:
+    """Ship the framework to every host + cluster_info to the head.
+
+    Reference ships a locally-built wheel (wheel_utils.py:61-140) then
+    pip-installs it; we rsync the package source into
+    ~/.skyt_agent/runtime/skypilot_tpu — zero-install, python3 is enough.
+    """
+    hosts = info.sorted_instances()
+
+    def _setup_host(host: common.InstanceInfo) -> None:
+        runner = command_runner.runner_from_spec(host.runner_spec)
+        runner.run(f'mkdir -p {agent_constants.RUNTIME_DIR} '
+                   f'{agent_constants.JOBS_DIR} {agent_constants.LOGS_DIR}',
+                   check=True)
+        runner.rsync(str(_PACKAGE_ROOT) + '/',
+                     f'{agent_constants.RUNTIME_DIR}/skypilot_tpu/',
+                     up=True)
+
+    subprocess_utils.run_in_parallel(_setup_host, hosts)
+
+    # Head gets cluster_info.json (how the gang executor reaches workers)
+    # and the cluster private key for head->worker fan-out.
+    head = info.head_instance
+    head_runner = command_runner.runner_from_spec(head.runner_spec)
+    info_json = json.dumps(info.to_dict())
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, 'cluster_info.json')
+        with open(p, 'w') as f:
+            f.write(info_json)
+        head_runner.rsync(p, agent_constants.CLUSTER_INFO, up=True)
+        private_key, _ = authentication.get_or_generate_keys()
+        if os.path.exists(private_key):
+            head_runner.rsync(private_key,
+                              f'{agent_constants.AGENT_HOME}/ssh_key',
+                              up=True)
+            head_runner.run(
+                f'chmod 600 {agent_constants.AGENT_HOME}/ssh_key',
+                check=False)
+
+
+def start_agent_daemon(info: common.ClusterInfo) -> None:
+    """Start the head daemon (autostop etc.; reference: skylet start,
+    instance_setup.py:440). Idempotent via pidfile."""
+    head_runner = command_runner.runner_from_spec(
+        info.head_instance.runner_spec)
+    pidfile = f'{agent_constants.AGENT_HOME}/daemon.pid'
+    cmd = (
+        f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; '
+        f'then true; else '
+        f'PYTHONPATH={agent_constants.RUNTIME_DIR} '
+        f'nohup python3 -m skypilot_tpu.agent.daemon '
+        f'>> {agent_constants.AGENT_HOME}/daemon.log 2>&1 & '
+        f'echo $! > {pidfile}; fi')
+    head_runner.run(cmd, check=False)

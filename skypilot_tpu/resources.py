@@ -71,6 +71,9 @@ class Resources:
         syntax ('tpu-v5e-8', {'tpu-v5e-8': 1}) for familiarity
         (reference: resources.py:545 _set_accelerators)."""
         tpu = kwargs.pop('tpu', None)
+        if tpu is not None and accelerators is not None:
+            raise exceptions.InvalidResourcesError(
+                'Pass either tpu= or accelerators=, not both.')
         if accelerators is not None:
             if isinstance(accelerators, dict):
                 if len(accelerators) != 1:

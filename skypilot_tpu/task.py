@@ -107,7 +107,12 @@ class Task:
         task.resources = resources_lib.Resources.from_yaml_config(
             config.get('resources'))
         if config.get('service') is not None:
-            from skypilot_tpu.serve import service_spec
+            try:
+                from skypilot_tpu.serve import service_spec
+            except ImportError as e:
+                raise exceptions.InvalidTaskError(
+                    'This build does not include the serve subsystem; '
+                    f'`service:` sections are unsupported ({e}).') from e
             task.service = service_spec.SkyServiceSpec.from_yaml_config(
                 config['service'])
         return task

@@ -183,10 +183,11 @@ def parse_tpu_type(tpu_type: str) -> TpuTopology:
     # Host layout: single-host below the threshold, full hosts for pods.
     if suffix <= info.max_single_host_suffix or num_chips <= info.chips_per_host:
         # Sub-host shapes exist only in the sizes GCP actually offers
-        # (v5litepod-1/-4/-8 etc.) — reject v5e-3 here, not at the TPU API.
+        # (v5litepod-1/-4/-8; cores-suffixed gens start at -8) — reject
+        # v5e-3 / v5p-4 here, not at the TPU API.
         valid_single = set(info.sub_host_suffixes) | {
             info.max_single_host_suffix}
-        if suffix not in valid_single and info.sub_host_suffixes:
+        if suffix not in valid_single:
             raise exceptions.InvalidResourcesError(
                 f'TPU {tpu_type}: single-host {gen} slices come in sizes '
                 f'{sorted(valid_single)}.')

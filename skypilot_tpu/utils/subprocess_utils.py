@@ -1,0 +1,21 @@
+"""Thread-pool helpers (reference: sky/utils/subprocess_utils.py)."""
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Callable, Iterable, List, TypeVar
+
+T = TypeVar('T')
+R = TypeVar('R')
+
+
+def run_in_parallel(fn: Callable[[T], R], args: Iterable[T],
+                    max_workers: int = 32) -> List[R]:
+    """Run fn over args in threads; re-raises the first exception."""
+    items = list(args)
+    if not items:
+        return []
+    if len(items) == 1:
+        return [fn(items[0])]
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(max_workers, len(items))) as pool:
+        return list(pool.map(fn, items))

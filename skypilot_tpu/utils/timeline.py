@@ -1,0 +1,76 @@
+"""Chrome-trace event recording (reference: sky/utils/timeline.py, 133 LoC).
+
+Enabled by SKYT_TIMELINE_FILE; every @timeline.event-decorated call emits a
+complete ('ph': 'X') trace event. This instruments launch->first-step from
+day one (BASELINE.md north-star metric 1) — load the file in
+chrome://tracing or perfetto.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+_events: List[dict] = []
+_lock = threading.Lock()
+_registered = False
+
+
+def _enabled_path() -> Optional[str]:
+    return os.environ.get('SKYT_TIMELINE_FILE')
+
+
+def _flush() -> None:
+    path = _enabled_path()
+    if not path or not _events:
+        return
+    with open(os.path.expanduser(path), 'w') as f:
+        json.dump({'traceEvents': _events}, f)
+
+
+def record(name: str, start_s: float, end_s: float, **args: Any) -> None:
+    global _registered
+    if _enabled_path() is None:
+        return
+    with _lock:
+        if not _registered:
+            atexit.register(_flush)
+            _registered = True
+        _events.append({
+            'name': name, 'ph': 'X', 'pid': os.getpid(),
+            'tid': threading.get_ident(),
+            'ts': int(start_s * 1e6),
+            'dur': int((end_s - start_s) * 1e6),
+            'args': args,
+        })
+
+
+class Event:
+    """Context manager form: `with timeline.Event('provision'): ...`"""
+
+    def __init__(self, name: str, **args: Any) -> None:
+        self.name = name
+        self.args = args
+        self._start = 0.0
+
+    def __enter__(self) -> 'Event':
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        record(self.name, self._start, time.time(), **self.args)
+
+
+def event(fn: Callable) -> Callable:
+    """Decorator form (reference decorates launch/provision entry points)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        with Event(f'{fn.__module__}.{fn.__qualname__}'):
+            return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,203 @@
+"""End-to-end launch tests on the fake (localhost) cloud.
+
+This is the substrate the reference lacks (SURVEY.md §4): its multi-node
+paths are only covered by real-cloud smoke tests. Here the full client
+stack — optimizer -> failover provisioner -> runtime sync -> agent submit ->
+gang executor -> log streaming — runs against directory-hosts.
+"""
+import json
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core, exceptions, global_user_state
+from skypilot_tpu.provision.fake import instance as fake_cloud
+
+
+def _task(run, *, accel='tpu-v5e-8', nodes=1, name='t', setup=None,
+          envs=None, workdir=None):
+    t = sky.Task(name=name, run=run, num_nodes=nodes, setup=setup,
+                 envs=envs, workdir=workdir)
+    t.set_resources(sky.Resources.new(accelerators=accel, cloud='fake'))
+    return t
+
+
+def _wait_job(cluster, job_id, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = core.job_status(cluster, job_id)
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'):
+            return status
+        time.sleep(0.2)
+    raise TimeoutError(f'job {job_id} still {status}')
+
+
+def _rank_log(cluster, job_id, phase, rank):
+    home = os.environ['SKYT_HOME']
+    path = (f'{home}/fake_cloud/clusters/{cluster}/node0-host0/'
+            f'.skyt_agent/logs/{job_id}/{phase}-rank{rank}.log')
+    with open(path) as f:
+        return f.read()
+
+
+def test_single_host_launch_and_logs():
+    job_id, handle = sky.launch(_task('echo out-$SKYT_NODE_RANK'),
+                                cluster_name='c1', quiet_optimizer=True)
+    assert job_id == 1
+    assert handle.cluster_info.num_hosts == 1
+    assert 'out-0' in _rank_log('c1', job_id, 'run', 0)
+    assert core.job_status('c1', job_id) == 'SUCCEEDED'
+
+
+def test_pod_env_contract():
+    """2 slices x 2 hosts: ranks, coordinator, megascale vars."""
+    run = ('echo CONTRACT node=$SKYT_NODE_RANK host=$SKYT_HOST_RANK '
+           'pid=$SKYT_PROCESS_ID np=$SKYT_NUM_PROCESSES '
+           'coord=$SKYT_COORDINATOR_ADDRESS slice=$MEGASCALE_SLICE_ID '
+           'nslices=$MEGASCALE_NUM_SLICES compat=$SKYPILOT_NODE_RANK')
+    job_id, handle = sky.launch(_task(run, accel='tpu-v5e-16', nodes=2),
+                                cluster_name='pod', quiet_optimizer=True)
+    assert handle.cluster_info.num_hosts == 4
+    assert _wait_job('pod', job_id) == 'SUCCEEDED'
+    seen = {}
+    for rank in range(4):
+        log = _rank_log('pod', job_id, 'run', rank)
+        line = [l for l in log.splitlines() if 'CONTRACT' in l][0]
+        kv = dict(p.split('=') for p in line.split()[1:])
+        seen[rank] = kv
+    assert [seen[r]['pid'] for r in range(4)] == ['0', '1', '2', '3']
+    assert {seen[r]['np'] for r in range(4)} == {'4'}
+    assert seen[0]['node'] == '0' and seen[2]['node'] == '1'
+    assert seen[1]['host'] == '1' and seen[3]['host'] == '1'
+    assert seen[0]['slice'] == '0' and seen[3]['slice'] == '1'
+    assert {seen[r]['nslices'] for r in range(4)} == {'2'}
+    # coordinator identical everywhere; compat alias mirrors node rank.
+    assert len({seen[r]['coord'] for r in range(4)}) == 1
+    assert seen[2]['compat'] == '1'
+
+
+def test_gang_all_or_nothing():
+    """One host failing kills the survivors (reference get_or_fail
+    semantics, cloud_vm_ray_backend.py:314-350)."""
+    run = ('if [ "$SKYT_PROCESS_ID" = "1" ]; then sleep 0.5; exit 7; fi\n'
+           'sleep 120; echo SURVIVED')
+    job_id, _ = sky.launch(_task(run, accel='tpu-v5e-16'),
+                           cluster_name='gang', quiet_optimizer=True,
+                           detach_run=True)
+    status = _wait_job('gang', job_id, timeout=30)
+    assert status == 'FAILED'
+    # the healthy rank was killed, never printed SURVIVED
+    assert 'SURVIVED' not in _rank_log('gang', job_id, 'run', 0)
+
+
+def test_setup_failure_marks_failed_setup():
+    job_id, _ = sky.launch(_task('echo never', setup='exit 3'),
+                           cluster_name='fs', quiet_optimizer=True,
+                           detach_run=True)
+    assert _wait_job('fs', job_id) == 'FAILED_SETUP'
+
+
+def test_exec_reuse_and_fifo_queue():
+    t = _task('sleep 1; echo first')
+    job1, handle = sky.launch(t, cluster_name='q', quiet_optimizer=True,
+                              detach_run=True)
+    job2, _ = sky.exec(_task('echo second'), cluster_name='q',
+                       detach_run=True)
+    assert job2 == job1 + 1
+    assert _wait_job('q', job2) == 'SUCCEEDED'
+    queue = core.queue('q')
+    by_id = {j['job_id']: j for j in queue}
+    assert by_id[job1]['status'] == 'SUCCEEDED'
+    # FIFO: job2 started after job1 ended
+    assert by_id[job2]['started_at'] >= by_id[job1]['ended_at'] - 0.5
+
+
+def test_cancel():
+    job_id, _ = sky.launch(_task('sleep 300'), cluster_name='cx',
+                           quiet_optimizer=True, detach_run=True)
+    deadline = time.time() + 20
+    while core.job_status('cx', job_id) not in ('RUNNING',):
+        assert time.time() < deadline
+        time.sleep(0.2)
+    cancelled = core.cancel('cx', job_id)
+    assert job_id in cancelled
+    assert _wait_job('cx', job_id) == 'CANCELLED'
+
+
+def test_workdir_sync():
+    import pathlib
+    wd = pathlib.Path(os.environ['SKYT_HOME']).parent / 'wd'
+    wd.mkdir(parents=True)
+    (wd / 'data.txt').write_text('payload42')
+    job_id, _ = sky.launch(_task('cat data.txt', workdir=str(wd)),
+                           cluster_name='wds', quiet_optimizer=True)
+    assert 'payload42' in _rank_log('wds', job_id, 'run', 0)
+
+
+def test_failover_on_capacity():
+    """Zone stockout -> next zone; quota region -> skipped entirely."""
+    fake_cloud.set_capacity(
+        zones={'us-central1-a': 0, 'us-west1-c': 0},
+        quota_fail_regions=['us-east1'])
+    job_id, handle = sky.launch(_task('true'), cluster_name='fo',
+                                quiet_optimizer=True)
+    zone = handle.cluster_info.zone
+    assert zone not in ('us-central1-a', 'us-west1-c')
+    assert not zone.startswith('us-east1')
+
+
+def test_all_zones_exhausted_raises():
+    zones = {z: 0 for z in
+             ('us-central1-a us-west1-c us-west4-a us-east1-c us-east5-b '
+              'europe-west4-b asia-southeast1-b').split()}
+    fake_cloud.set_capacity(zones=zones)
+    with pytest.raises(exceptions.ResourcesUnavailableError):
+        sky.launch(_task('true'), cluster_name='nope', quiet_optimizer=True)
+
+
+def test_pod_cannot_stop_but_can_down():
+    _, handle = sky.launch(_task('true', accel='tpu-v5e-16'),
+                           cluster_name='podstop', quiet_optimizer=True)
+    with pytest.raises(exceptions.NotSupportedError):
+        core.stop('podstop')
+    core.down('podstop')
+    assert global_user_state.get_cluster('podstop') is None
+
+
+def test_stop_start_cycle_single_host():
+    sky.launch(_task('true'), cluster_name='ss', quiet_optimizer=True)
+    core.stop('ss')
+    rec = global_user_state.get_cluster('ss')
+    assert rec['status'] == global_user_state.ClusterStatus.STOPPED
+    core.start('ss')
+    rec = global_user_state.get_cluster('ss')
+    assert rec['status'] == global_user_state.ClusterStatus.UP
+    job2, _ = sky.exec(_task('echo back'), cluster_name='ss')
+    assert core.job_status('ss', job2) == 'SUCCEEDED'
+
+
+def test_status_refresh_detects_external_termination():
+    sky.launch(_task('true'), cluster_name='drift', quiet_optimizer=True)
+    # Simulate out-of-band termination (reference: smoke test
+    # test_basic.py:197 kills instances behind SkyPilot's back).
+    fake_cloud.terminate_instances('drift')
+    records = core.status(['drift'], refresh=True)
+    assert records == []
+    assert global_user_state.get_cluster('drift') is None
+
+
+def test_dryrun_provisions_nothing():
+    job_id, handle = sky.launch(_task('true'), cluster_name='dry',
+                                dryrun=True, quiet_optimizer=True)
+    assert job_id is None and handle is None
+    assert global_user_state.get_cluster('dry') is None
+
+
+def test_cost_report_accumulates():
+    sky.launch(_task('true'), cluster_name='cost', quiet_optimizer=True)
+    core.down('cost')
+    report = {r['name']: r for r in core.cost_report()}
+    assert 'cost' in report
+    assert report['cost']['cost'] >= 0

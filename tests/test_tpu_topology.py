@@ -74,5 +74,8 @@ def test_sub_host_sizes_enforced():
         tpu_topology.parse_tpu_type('tpu-v5e-3')
     with pytest.raises(exceptions.InvalidResourcesError):
         tpu_topology.parse_tpu_type('tpu-v6e-7')
-    # v2-v5p have no sub-host shapes defined; multiples of cores still parse.
-    assert tpu_topology.parse_tpu_type('tpu-v4-4').num_chips == 2
+    # Cores-suffixed gens start at -8: v5p-4 / v4-4 don't exist on GCP.
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v5p-4')
+    with pytest.raises(exceptions.InvalidResourcesError):
+        tpu_topology.parse_tpu_type('tpu-v4-4')
