@@ -1,0 +1,98 @@
+"""Benchmark: Llama training step MFU on one TPU chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference's headline TPU training number is
+Llama-3-8B via HF run_clm + torch-xla FSDP on v6e: 0.476 samples/s @ seq
+8192 on 8 chips = 487 tokens/s/chip. With flops/token = 6N + 12*L*D*S =
+6.1e10 that is 487 * 6.1e10 / 918e12 = 3.24% MFU (their 20-step
+train_runtime includes compile — it is the only published number, SURVEY §6).
+
+We measure the same quantity — model-FLOPs utilization of a dense-Llama
+train step (fwd+bwd+adamw, bf16, remat, flash attention) — on whatever chip
+is attached, with a model sized to the chip's HBM, and report
+vs_baseline = our_MFU / 3.24%.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+REF_MFU_PCT = 3.24
+
+
+def _tpu_chip_flops(device) -> float:
+    kind = getattr(device, 'device_kind', '').lower()
+    table = {
+        'v2': 90e12, 'v3': 123e12, 'v4': 275e12,
+        'v5 lite': 197e12, 'v5litepod': 197e12, 'v5e': 197e12,
+        'v5p': 459e12, 'v6 lite': 918e12, 'v6e': 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12  # default: v5e
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.train import trainer
+
+    device = jax.devices()[0]
+    on_tpu = device.platform != 'cpu'
+
+    if on_tpu:
+        # ~500M params: fits one v5e chip (16 GB) with fp32 adam moments.
+        cfg = llama.LlamaConfig(
+            vocab_size=32768, dim=1536, n_layers=12, n_heads=12,
+            n_kv_heads=4, ffn_dim=6144, max_seq_len=2048,
+            use_flash_attention=True)
+        batch, seq, steps = 8, 2048, 20
+    else:
+        cfg = llama.llama_tiny()
+        batch, seq, steps = 4, 128, 3
+
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(),
+                              devices=jax.devices()[:1])
+    state, shardings, opt = trainer.init_train_state(cfg, mesh)
+    step = trainer.make_train_step(cfg, mesh, opt, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (batch, seq + 1),
+                                0, cfg.vocab_size)
+    batch_dict = {'tokens': tokens}
+
+    # Warmup / compile. Sync with a host transfer (float()), not
+    # block_until_ready: through remote-execution relays (axon tunnel) the
+    # latter can return before the computation actually retires.
+    state, metrics = step(state, batch_dict)
+    float(metrics['loss'])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_dict)
+    final_loss = float(metrics['loss'])
+    dt = time.perf_counter() - t0
+    assert 0.0 < final_loss < 30.0, f'suspicious loss {final_loss}'
+
+    tokens_per_step = batch * seq
+    tok_per_s = tokens_per_step * steps / dt
+    flops_per_token = cfg.flops_per_token(seq)
+    peak = _tpu_chip_flops(device) if on_tpu else 1e12
+    mfu_pct = 100.0 * tok_per_s * flops_per_token / peak
+
+    print(json.dumps({
+        'metric': 'llama_train_mfu_single_chip',
+        'value': round(mfu_pct, 2),
+        'unit': '% of peak bf16 FLOPs '
+                f'({int(tok_per_s)} tok/s/chip, {cfg.num_params/1e6:.0f}M '
+                f'params, seq {seq}, {device.device_kind or "cpu"})',
+        'vs_baseline': round(mfu_pct / REF_MFU_PCT, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()

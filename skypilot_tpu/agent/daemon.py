@@ -63,6 +63,13 @@ def check_autostop() -> None:
 
 
 def main() -> None:
+    # Rewrite the idle boot marker on every daemon start: a stale marker
+    # surviving a stop/start cycle would otherwise trip autostop ~20s
+    # after restart.
+    marker = os.path.expanduser(f'{constants.AGENT_HOME}/started_at')
+    os.makedirs(os.path.dirname(marker), exist_ok=True)
+    with open(marker, 'w') as f:
+        f.write(str(time.time()))
     while True:
         try:
             check_autostop()

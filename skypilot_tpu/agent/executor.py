@@ -95,9 +95,13 @@ class GangExecutor:
         self.log_dir = job_lib.log_dir(job_id)
         self._kill_lock = threading.Lock()
         self._killed = False
+        # A job may use fewer slices than the cluster has (exec of a 1-node
+        # task onto a 2-node cluster); it runs on the first N slices.
         expected = self.num_nodes * self.hosts_per_node
-        assert len(self.hosts) == expected, (
-            f'cluster has {len(self.hosts)} hosts, job wants {expected}')
+        if len(self.hosts) < expected:
+            raise RuntimeError(
+                f'cluster has {len(self.hosts)} hosts, job wants {expected}')
+        self.hosts = self.hosts[:expected]
 
     # ------------------------------------------------------------------ #
 
@@ -130,8 +134,7 @@ class GangExecutor:
             return f'run-node{host.node_index}.sh'
         return 'run.sh'
 
-    def _run_phase(self, phase: str,
-                   envs: Dict[str, str]) -> List[_HostRun]:
+    def _run_phase(self, phase: str) -> List[_HostRun]:
         """Start the phase script on every host; wait all-or-nothing."""
         runs = []
         for rank, host in enumerate(self.hosts):
@@ -185,17 +188,24 @@ class GangExecutor:
 
     def kill_all(self, runs_hint: Optional[List[_HostRun]] = None,
                  phase: Optional[str] = None) -> None:
+        from skypilot_tpu.utils import subprocess_utils
         phases = [phase] if phase else ['setup', 'run']
-        for rank, host in enumerate(self.hosts):
+
+        def _kill_host(item) -> None:
+            rank, host = item
             runner = command_runner.runner_from_spec(host.runner_spec)
-            for ph in phases:
-                pid_file = self._pid_file(rank, ph)
-                cmd = (f'[ -f {pid_file} ] && pid=$(cat {pid_file}) && '
-                       f'kill -TERM -- -$pid 2>/dev/null; true')
-                try:
-                    runner.run(cmd, timeout=20)
-                except Exception:  # noqa: BLE001 — best effort
-                    pass
+            cmd = '; '.join(
+                f'[ -f {pf} ] && pid=$(cat {pf}) && '
+                f'kill -TERM -- -$pid 2>/dev/null'
+                for pf in (self._pid_file(rank, ph) for ph in phases)
+            ) + '; true'
+            try:
+                runner.run(cmd, timeout=20)
+            except Exception:  # noqa: BLE001 — best effort
+                pass
+
+        subprocess_utils.run_in_parallel(_kill_host,
+                                         list(enumerate(self.hosts)))
 
     # ------------------------------------------------------------------ #
 
@@ -208,11 +218,10 @@ class GangExecutor:
             time.sleep(1)
 
         job_lib.set_executor_pid(self.job_id, os.getpid())
-        envs = self.spec.get('envs', {})
         self._stage_job()
 
         if self.spec.get('has_setup'):
-            runs = self._run_phase('setup', envs)
+            runs = self._run_phase('setup')
             if any(r.returncode != 0 for r in runs):
                 job_lib.set_status(self.job_id,
                                    job_lib.JobStatus.FAILED_SETUP)
@@ -221,7 +230,7 @@ class GangExecutor:
         job_lib.set_status(self.job_id, job_lib.JobStatus.RUNNING)
         if self.spec.get('has_run'):
             self._killed = False
-            runs = self._run_phase('run', envs)
+            runs = self._run_phase('run')
             if self._cancelled():
                 return job_lib.JobStatus.CANCELLED
             if any(r.returncode != 0 for r in runs):
@@ -252,16 +261,27 @@ def spawn_detached(job_id: int) -> None:
 
 def main() -> None:
     job_id = int(sys.argv[1])
-    executor = GangExecutor(job_id)
+    try:
+        executor = GangExecutor(job_id)
 
-    def _on_term(signum, frame):  # cancel path
-        del signum, frame
-        job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED)
-        executor.kill_all()
+        def _on_term(signum, frame):  # cancel path
+            del signum, frame
+            job_lib.set_status(job_id, job_lib.JobStatus.CANCELLED)
+            executor.kill_all()
+            sys.exit(1)
+
+        signal.signal(signal.SIGTERM, _on_term)
+        status = executor.execute()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001
+        # An executor crash must never wedge the FIFO queue: a job stuck in
+        # PENDING/SETTING_UP/RUNNING blocks every later job's try_start.
+        with open(os.path.join(job_lib.log_dir(job_id), 'driver.log'),
+                  'a') as f:
+            f.write(f'[executor] fatal: {type(e).__name__}: {e}\n')
+        job_lib.set_status(job_id, job_lib.JobStatus.FAILED)
         sys.exit(1)
-
-    signal.signal(signal.SIGTERM, _on_term)
-    status = executor.execute()
     sys.exit(0 if status == job_lib.JobStatus.SUCCEEDED else 1)
 
 

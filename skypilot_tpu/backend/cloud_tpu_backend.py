@@ -99,20 +99,30 @@ class CloudTpuBackend:
                         f'{task.num_nodes}x {res}')
             return None
         existing = global_user_state.get_cluster(cluster_name)
+        num_nodes = task.num_nodes
         if existing is not None and existing['handle'] is not None:
             handle = existing['handle']
             if existing['status'] == global_user_state.ClusterStatus.UP:
                 self._check_task_fits(task, handle)
                 logger.info(f'Reusing existing cluster {cluster_name!r}.')
                 return handle
-            # STOPPED/INIT -> re-run provisioning (resume path).
+            # STOPPED/INIT resume: the cluster already lives in a concrete
+            # zone — pin to it rather than roaming failover candidates,
+            # which would create duplicates elsewhere while the stopped
+            # resources still exist (and whose per-attempt cleanup could
+            # delete them). Reference reuses the previous zone the same way
+            # (_yield_zones, cloud_vm_ray_backend.py:1230).
+            res = handle.launched_resources
+            num_nodes = handle.launched_nodes
+            candidates = [c for c in res.get_offerings()
+                          if res.zone is None or c.zone == res.zone]
         result = provisioner.provision_with_failover(
             cluster_name=cluster_name, cloud=res.cloud, resources=res,
-            num_nodes=task.num_nodes, candidates=candidates,
+            num_nodes=num_nodes, candidates=candidates,
             ports=list(res.ports))
         handle = ClusterHandle(
             cluster_name=cluster_name, cloud=res.cloud,
-            launched_nodes=task.num_nodes,
+            launched_nodes=num_nodes,
             launched_resources=result.resources,
             cluster_info=result.cluster_info)
         global_user_state.add_or_update_cluster(

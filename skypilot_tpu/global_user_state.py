@@ -168,6 +168,18 @@ def _row_to_record(row) -> Dict[str, Any]:
 # Cost history (reference: global_user_state.py:469-510)
 # --------------------------------------------------------------------- #
 
+def _normalize_intervals(intervals: List[Any]) -> List[Dict[str, Any]]:
+    """Migrate legacy (start, end) tuple entries to the dict form."""
+    out = []
+    for iv in intervals:
+        if isinstance(iv, dict):
+            out.append(iv)
+        else:
+            start, end = iv
+            out.append({'start': start, 'end': end, 'hourly_cost': 0.0})
+    return out
+
+
 def _record_history_start(name: str, handle: Any) -> None:
     """Open a usage interval. Each interval carries the hourly price in
     effect when it opened, so relaunching the same cluster name on pricier
@@ -176,7 +188,8 @@ def _record_history_start(name: str, handle: Any) -> None:
     row = conn.execute(
         'SELECT usage_intervals FROM cluster_history WHERE cluster_name=?',
         (name,)).fetchone()
-    intervals = pickle.loads(row[0]) if row and row[0] else []
+    intervals = _normalize_intervals(
+        pickle.loads(row[0]) if row and row[0] else [])
     resources_str = str(getattr(handle, 'launched_resources', ''))
     num_nodes = getattr(handle, 'launched_nodes', 1)
     hourly = 0.0
@@ -206,7 +219,7 @@ def _record_history_stop(name: str) -> None:
         (name,)).fetchone()
     if not row or not row[0]:
         return
-    intervals = pickle.loads(row[0])
+    intervals = _normalize_intervals(pickle.loads(row[0]))
     if intervals and intervals[-1]['end'] is None:
         intervals[-1]['end'] = time.time()
         conn.execute(
@@ -222,7 +235,7 @@ def get_cost_report() -> List[Dict[str, Any]]:
     report = []
     now = time.time()
     for name, blob, res_str, num_nodes, _ in rows:
-        intervals = pickle.loads(blob) if blob else []
+        intervals = _normalize_intervals(pickle.loads(blob) if blob else [])
         total_s = 0.0
         cost = 0.0
         for iv in intervals:

@@ -1,0 +1,4 @@
+from skypilot_tpu.parallel.mesh import MeshShape, make_mesh
+from skypilot_tpu.parallel.distributed import initialize_from_env
+
+__all__ = ['MeshShape', 'make_mesh', 'initialize_from_env']

@@ -1,0 +1,49 @@
+"""Multi-host JAX bootstrap from the framework env contract.
+
+The reference's rendezvous is torchrun `--master_addr $(head -n1 <<<
+$SKYPILOT_NODE_IPS)` in recipe YAMLs (examples/resnet_distributed_torch.yaml
+:22-25). Here the gang executor exports SKYT_COORDINATOR_ADDRESS /
+SKYT_NUM_PROCESSES / SKYT_PROCESS_ID (agent/executor.py build_host_env) and
+user code calls one function:
+
+    from skypilot_tpu.parallel import initialize_from_env
+    initialize_from_env()   # no-op on single host
+
+Getting this wrong deadlocks jax.distributed.initialize silently
+(SURVEY.md §7 hard parts), which is why rank MUST be the TPU worker id —
+the executor guarantees process_id = node_index * hosts_per_node +
+host_index, matching libtpu's own topology numbering.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from skypilot_tpu.agent import constants
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> bool:
+    """Call jax.distributed.initialize from SKYT_* env. Returns True if
+    multi-host init happened, False for single-process runs."""
+    num_processes = int(os.environ.get(constants.ENV_NUM_PROCESSES, '1'))
+    if num_processes <= 1:
+        return False
+    import jax
+    coordinator = os.environ[constants.ENV_COORDINATOR]
+    process_id = int(os.environ[constants.ENV_PROCESS_ID])
+    kwargs = {}
+    if timeout_s is not None:
+        kwargs['initialization_timeout'] = timeout_s
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id, **kwargs)
+    return True
+
+
+def num_slices() -> int:
+    return int(os.environ.get(constants.ENV_MEGASCALE_NUM_SLICES, '1'))
+
+
+def slice_id() -> int:
+    return int(os.environ.get(constants.ENV_MEGASCALE_SLICE_ID, '0'))

@@ -1,0 +1,82 @@
+"""Device-mesh construction for SPMD training/serving.
+
+The reference delegates all intra-job parallelism to user frameworks
+(SURVEY.md §2.10: torchrun/DeepSpeed/vLLM flags in recipe YAMLs). Here the
+mesh IS the framework primitive: every model/train/serve component takes a
+`jax.sharding.Mesh` with canonical axis names and annotates arrays with
+PartitionSpecs over them; XLA inserts the collectives (psum/all-gather/
+reduce-scatter over ICI, DCN across slices).
+
+Canonical axes (any may be size 1):
+    'dp'    pure data parallel (across slices -> rides DCN)
+    'fsdp'  data parallel + param sharding (ZeRO-3 style; rides ICI)
+    'sp'    sequence/context parallel (ring attention; rides ICI neighbors)
+    'tp'    tensor parallel (megatron-style; innermost, most
+            communication-intensive -> fastest ICI axis)
+    'ep'    expert parallel (MoE); laid over the same physical axis as tp
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp')
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshShape:
+    """Logical mesh sizes. Product must equal the number of devices."""
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp
+
+    def as_tuple(self) -> Sequence[int]:
+        return (self.dp, self.fsdp, self.sp, self.tp)
+
+
+def make_mesh(shape: MeshShape,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with dp outermost and tp innermost.
+
+    `mesh_utils.create_device_mesh` maps the logical mesh onto the physical
+    ICI torus so that the innermost (most chatty) axis lands on
+    nearest-neighbor links; across slices, megascale env (exported by the
+    gang executor, agent/executor.py) routes the outer axis over DCN.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape.total != len(devices):
+        raise ValueError(
+            f'Mesh {shape} needs {shape.total} devices, have '
+            f'{len(devices)}.')
+    device_array = mesh_utils.create_device_mesh(shape.as_tuple(),
+                                                 devices=devices)
+    return Mesh(device_array, AXIS_ORDER)
+
+
+def default_mesh_shape(num_devices: int,
+                       tp: int = 1, sp: int = 1,
+                       dp: Optional[int] = None) -> MeshShape:
+    """FSDP-first default: everything not claimed by tp/sp/dp goes to fsdp
+    (the right default for 8B-class training on pods)."""
+    claimed = tp * sp * (dp or 1)
+    if num_devices % claimed != 0:
+        raise ValueError(
+            f'{num_devices} devices not divisible by tp*sp*dp={claimed}')
+    fsdp = num_devices // claimed
+    return MeshShape(dp=dp or 1, fsdp=fsdp, sp=sp, tp=tp)
+
+
+def single_device_mesh() -> Mesh:
+    """A trivial 1-device mesh so model code is mesh-agnostic."""
+    return make_mesh(MeshShape(), devices=jax.devices()[:1])

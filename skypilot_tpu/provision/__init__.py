@@ -15,7 +15,6 @@ implements:
 """
 from __future__ import annotations
 
-import functools
 import importlib
 from typing import Any
 
@@ -25,7 +24,6 @@ def _impl(cloud: str):
 
 
 def _route(fn_name: str):
-    @functools.wraps(getattr(object, '__init__', None), ('__name__',))
     def wrapper(cloud: str, *args: Any, **kwargs: Any) -> Any:
         module = _impl(cloud)
         fn = getattr(module, fn_name, None)

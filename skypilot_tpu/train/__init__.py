@@ -1,0 +1,5 @@
+from skypilot_tpu.train.trainer import (TrainState, cross_entropy_loss,
+                                        make_train_step, init_train_state)
+
+__all__ = ['TrainState', 'cross_entropy_loss', 'make_train_step',
+           'init_train_state']

@@ -7,12 +7,18 @@
 """
 import os
 
-# Must happen before any jax import anywhere in the test process.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must happen before any jax import anywhere in the test process. Note the
+# axon TPU-tunnel sitecustomize force-registers its platform, so the env
+# var alone is not enough — we also pin jax_platforms after import.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest
 

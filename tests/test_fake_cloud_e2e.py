@@ -201,3 +201,15 @@ def test_cost_report_accumulates():
     report = {r['name']: r for r in core.cost_report()}
     assert 'cost' in report
     assert report['cost']['cost'] >= 0
+
+
+def test_exec_smaller_task_on_bigger_cluster():
+    """A 1-node task on a 2-node cluster runs on the first slice only
+    (review regression: executor used to assert exact gang size)."""
+    sky.launch(_task('true', nodes=2), cluster_name='sub',
+               quiet_optimizer=True)
+    job2, _ = sky.exec(_task('echo small', nodes=1), cluster_name='sub',
+                       detach_run=True)
+    assert _wait_job('sub', job2) == 'SUCCEEDED'
+    log = _rank_log('sub', job2, 'run', 0)
+    assert 'small' in log
