@@ -112,10 +112,12 @@ class CloudTpuBackend:
             # resources still exist (and whose per-attempt cleanup could
             # delete them). Reference reuses the previous zone the same way
             # (_yield_zones, cloud_vm_ray_backend.py:1230).
+            self._check_task_fits(task, handle)
             res = handle.launched_resources
             num_nodes = handle.launched_nodes
-            candidates = [c for c in res.get_offerings()
-                          if res.zone is None or c.zone == res.zone]
+            # launched_resources is zone-pinned, so get_offerings() only
+            # returns that zone's offering.
+            candidates = res.get_offerings()
         result = provisioner.provision_with_failover(
             cluster_name=cluster_name, cloud=res.cloud, resources=res,
             num_nodes=num_nodes, candidates=candidates,

@@ -95,7 +95,7 @@ def llama_tiny() -> LlamaConfig:
 def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     d, f, l, v = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.vocab_size
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
 
     def norm_init(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) /
@@ -115,7 +115,7 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
             'ln_mlp': jnp.ones((l, d), cfg.dtype),
         },
         'final_norm': jnp.ones((d,), cfg.dtype),
-        'lm_head': norm_init(keys[0], (v, d), d),
+        'lm_head': norm_init(keys[8], (v, d), d),
     }
 
 
@@ -199,14 +199,21 @@ def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, s, h, hd)
 
 
+def _kernel_compatible(q: jax.Array) -> bool:
+    """Flash kernel constraints: lane-width head dim, block-divisible seq."""
+    seq, head_dim = q.shape[1], q.shape[3]
+    if head_dim % 128 != 0:
+        return False
+    from skypilot_tpu.ops import flash_attention as fa
+    block = min(fa.DEFAULT_BLOCK_Q, seq)
+    return seq >= 128 and seq % block == 0
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               cfg: LlamaConfig) -> jax.Array:
-    if cfg.use_flash_attention and q.shape[1] >= 128:
-        try:
-            from skypilot_tpu.ops import flash_attention
-            return flash_attention.flash_attention(q, k, v, causal=True)
-        except Exception:  # noqa: BLE001 — fall back off-TPU
-            pass
+    if cfg.use_flash_attention and _kernel_compatible(q):
+        from skypilot_tpu.ops import flash_attention
+        return flash_attention.flash_attention(q, k, v, causal=True)
     return _reference_attention(q, k, v)
 
 

@@ -1,0 +1,434 @@
+"""Flash attention as Pallas TPU kernels (fwd + custom-VJP bwd).
+
+The reference's long-context story is a recipe flag (`--flash_attention`
+hands off to torch-xla, examples/tpu/v6e/train-llama3-8b.yaml:52); here the
+kernel is in-framework. FlashAttention-2 style:
+
+  * forward: online softmax over KV blocks; O(S) memory; saves per-row
+    logsumexp for the backward.
+  * backward: two kernels — dQ (grid over Q blocks, loop KV) and dK/dV
+    (grid over KV blocks, loop Q) — recomputing P from (Q, K, lse); GQA
+    group-summing for dK/dV happens outside the kernel.
+  * `q_offset` / `kv_offset` are *dynamic* scalars (scalar-prefetch), so
+    the same kernel serves self-attention (offsets 0) and ring/context
+    parallelism, where each step attends to a rotated KV chunk whose global
+    position is only known at runtime (parallel/ring.py).
+
+Layout contract: q [B, Hq, Sq, D], k/v [B, Hkv, Skv, D]; Hq % Hkv == 0;
+Sq/Skv multiples of the block sizes (the public wrapper in
+models/llama.py falls back to the einsum path otherwise); D a multiple of
+128 (lane width).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_KV = 512
+_NEG_INF = -1e30
+
+
+def _block_sizes(sq: int, skv: int, bq: int, bkv: int) -> Tuple[int, int]:
+    return min(bq, sq), min(bkv, skv)
+
+
+# ===================================================================== #
+# Forward
+# ===================================================================== #
+
+def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, causal: bool, scale: float,
+                block_q: int, block_kv: int, num_kv: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qoff_ref[0] + qi * block_q
+    kv_start = koff_ref[0] + ki * block_kv
+
+    # Skip blocks fully above the causal diagonal (big win for long seq).
+    should_run = True
+    if causal:
+        should_run = kv_start <= q_start + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)       # [BQ, D]
+        k = k_ref[0, 0].astype(jnp.float32)       # [BKV, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [BQ, BKV]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:, :1]                      # [BQ, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(jnp.maximum(m_prev, _NEG_INF) - m_new)
+        l_new = l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_scr[:, :1] + jnp.log(safe_l), _NEG_INF)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool,
+         scale: float, q_offset, kv_offset,
+         block_q: int, block_kv: int) -> Tuple[jax.Array, jax.Array]:
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = hq // hkv
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    nq, nkv = sq // bq, skv // bkv
+
+    grid = (b, hq, nq, nkv)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, scale=scale, block_q=bq, block_kv=bkv,
+        num_kv=nkv)
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+        jax.ShapeDtypeStruct((b, hq, sq, 128), jnp.float32),
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, qi, ki, qo, ko:
+                             (b_, h // group, ki, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, qi, ki, qo, ko:
+                             (b_, h // group, ki, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bq, 128),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=out_shapes,
+    )(jnp.asarray([q_offset], jnp.int32), jnp.asarray([kv_offset], jnp.int32),
+      q, k, v)
+    return o, lse[..., 0]
+
+
+# ===================================================================== #
+# Backward
+# ===================================================================== #
+
+def _bwd_dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, dq_scr, *, causal: bool,
+                   scale: float, block_q: int, block_kv: int, num_kv: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qoff_ref[0] + qi * block_q
+    kv_start = koff_ref[0] + ki * block_kv
+    should_run = True
+    if causal:
+        should_run = kv_start <= q_start + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                    causal: bool, scale: float, block_q: int,
+                    block_kv: int, num_q: int):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qoff_ref[0] + qi * block_q
+    kv_start = koff_ref[0] + ki * block_kv
+    should_run = True
+    if causal:
+        should_run = kv_start <= q_start + block_q - 1
+
+    @pl.when(should_run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - lse), 0.0)
+        # dv += P^T @ dO ; dk += dS^T @ Q
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
+         q_offset, kv_offset, block_q: int, block_kv: int):
+    b, hq, sq, d = q.shape
+    _, hkv, skv, _ = k.shape
+    group = hq // hkv
+    bq, bkv = _block_sizes(sq, skv, block_q, block_kv)
+    nq, nkv = sq // bq, skv // bkv
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                  # [B, Hq, Sq]
+    lse_b = jnp.broadcast_to(lse[..., None], (b, hq, sq, 128))
+    delta_b = jnp.broadcast_to(delta[..., None], (b, hq, sq, 128))
+    qoff = jnp.asarray([q_offset], jnp.int32)
+    koff = jnp.asarray([kv_offset], jnp.int32)
+
+    common_in_specs = [
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda b_, h, *idx: (b_, h, idx[0], 0)),
+    ]
+    del common_in_specs  # explicit per-kernel specs below for clarity
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_kv=bkv, num_kv=nkv),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hq, nq, nkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, qi, ki, qo, ko:
+                             (b_, h // group, ki, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, qi, ki, qo, ko:
+                             (b_, h // group, ki, 0)),
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bq, 128),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bq, 128),
+                             lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, bq, d),
+                lambda b_, h, qi, ki, qo, ko: (b_, h, qi, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(qoff, koff, q, k, v, do, lse_b, delta_b)
+
+    # Per-Q-head dk/dv, then sum over GQA groups outside the kernel.
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          block_q=bq, block_kv=bkv, num_q=nq),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hq, nkv, nq),
+            in_specs=[
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, ki, qi, qo, ko:
+                             (b_, h // group, ki, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, ki, qi, qo, ko:
+                             (b_, h // group, ki, 0)),
+                pl.BlockSpec((1, 1, bq, d),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bq, 128),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, qi, 0)),
+                pl.BlockSpec((1, 1, bq, 128),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, qi, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, ki, 0)),
+                pl.BlockSpec((1, 1, bkv, d),
+                             lambda b_, h, ki, qi, qo, ko: (b_, h, ki, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bkv, d), jnp.float32),
+                            pltpu.VMEM((bkv, d), jnp.float32)],
+        ),
+        out_shape=(jax.ShapeDtypeStruct((b, hq, skv, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, hq, skv, d), v.dtype)),
+    )(qoff, koff, q, k, v, do, lse_b, delta_b)
+
+    if group > 1:
+        dk = dk_full.reshape(b, hkv, group, skv, d).sum(axis=2)
+        dv = dv_full.reshape(b, hkv, group, skv, d).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ===================================================================== #
+# Public API with custom VJP
+# ===================================================================== #
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8))
+def _flash(q, k, v, causal, scale, q_offset, kv_offset, block_q, block_kv):
+    o, _ = _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, q_offset, kv_offset,
+                    block_q, block_kv):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                  kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+    return o, (q, k, v, o, lse, q_offset, kv_offset)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_kv, res, do):
+    q, k, v, o, lse, q_offset, kv_offset = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, causal=causal, scale=scale,
+                      q_offset=q_offset, kv_offset=kv_offset,
+                      block_q=block_q, block_kv=block_kv)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def reference_attention_hsd(q, k, v, *, causal: bool = True,
+                            scale: Optional[float] = None,
+                            q_offset=0, kv_offset=0):
+    """Offset-aware einsum attention returning (o, lse). Same contract as
+    the kernel; used off-TPU (ring attention tests on the CPU mesh) and as
+    the numerical oracle in tests. GQA via head broadcast."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, hq, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, hkv, group, sq, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    s = jnp.einsum('bkgqd,bksd->bkgqs', qf, kf) * scale
+    if causal:
+        rows = q_offset + jnp.arange(sq)[:, None]
+        cols = kv_offset + jnp.arange(skv)[None, :]
+        s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.maximum(m, _NEG_INF)
+    p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_safe), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum('bkgqs,bksd->bkgqd', p / jnp.maximum(l, 1e-30), vf)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+    return (o.reshape(b, hq, sq, d).astype(q.dtype),
+            lse.reshape(b, hq, sq))
+
+
+def flash_attention_hsd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        causal: bool = True,
+                        scale: Optional[float] = None,
+                        q_offset=0, kv_offset=0,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_kv: int = DEFAULT_BLOCK_KV,
+                        return_lse: bool = False):
+    """[B, H, S, D]-layout entry. `return_lse=True` skips the custom VJP
+    (used by ring attention, which does its own chunk merging). Off-TPU
+    (no Mosaic compiler) this transparently uses the einsum reference."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if jax.default_backend() == 'cpu':
+        o, lse = reference_attention_hsd(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            kv_offset=kv_offset)
+        return (o, lse) if return_lse else o
+    if return_lse:
+        return _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                    kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+    return _flash(q, k, v, causal, scale, q_offset, kv_offset,
+                  block_q, block_kv)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True) -> jax.Array:
+    """[B, S, H, D]-layout entry matching models/llama.py attention()."""
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    o = flash_attention_hsd(qh, kh, vh, causal=causal)
+    return jnp.swapaxes(o, 1, 2)

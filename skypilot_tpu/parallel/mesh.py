@@ -18,7 +18,6 @@ Canonical axes (any may be size 1):
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Optional, Sequence
 
 import jax

@@ -1,0 +1,93 @@
+"""Ring attention: causal attention over a sequence sharded on the 'sp'
+mesh axis.
+
+Absent from the reference entirely (SURVEY.md §2.10: no SP/CP code or
+recipe flags anywhere). Design: each device holds a contiguous sequence
+chunk of Q, K, V. For sp devices we run sp steps; at step i a device
+attends its local Q chunk against the KV chunk it currently holds (which
+originated on device (idx - i) mod sp), then passes KV to its ring
+neighbor with `lax.ppermute` — collectives ride nearest-neighbor ICI
+links. Per-chunk outputs are merged with the standard logsumexp
+combination, so the result is exactly softmax over the full sequence.
+
+Causality with chunked layout: chunk c covers global positions
+[c*C, (c+1)*C); a device's Q chunk q_idx attends KV chunk kv_idx fully
+when kv_idx < q_idx, diagonally when equal, not at all when greater. All
+three cases fall out of the flash kernel's dynamic q_offset/kv_offset
+masking — fully-masked chunks yield lse=-inf and drop out of the merge.
+
+Memory note: the forward holds one KV chunk at a time (O(S/sp)); reverse-
+mode autodiff through the scan stores each step's KV carry, so the
+backward currently peaks at O(S) per device. A dedicated backward ring
+(re-rotating KV) is the planned optimization; wrap the loss in
+`jax.checkpoint` to keep activations flat meanwhile.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two partial attention results with their logsumexps."""
+    lse_max = jnp.maximum(lse1, lse2)
+    a1 = jnp.exp(lse1 - lse_max)
+    a2 = jnp.exp(lse2 - lse_max)
+    denom = a1 + a2
+    safe = jnp.maximum(denom, 1e-30)
+    o = (o1 * (a1 / safe)[..., None] + o2 * (a2 / safe)[..., None])
+    lse = lse_max + jnp.log(safe)
+    return o, lse
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = 'sp',
+                   causal: bool = True) -> jax.Array:
+    """Call INSIDE shard_map/jit with sequence sharded on `axis_name`.
+
+    q [B, H, C, D], k/v [B, Hkv, C, D] — local chunks (C = S / sp).
+    Returns the local output chunk [B, H, C, D].
+    """
+    from skypilot_tpu.ops import flash_attention as fa
+
+    sp = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, c, d = q.shape
+    chunk = c
+
+    o0 = jnp.zeros((b, h, c, d), jnp.float32)
+    lse0 = jnp.full((b, h, c), -1e30, jnp.float32)
+    # Mark the accumulators as device-varying along the ring axis so the
+    # scan carry type matches its (my_idx-dependent) outputs.
+    o0, lse0 = jax.lax.pvary((o0, lse0), (axis_name,))
+
+    def step(carry, i):
+        o, lse, kc, vc = carry
+        src = (my_idx - i) % sp           # which chunk we currently hold
+        oi, lsei = fa.flash_attention_hsd(
+            q, kc, vc, causal=causal,
+            q_offset=my_idx * chunk, kv_offset=src * chunk,
+            return_lse=True)
+        o, lse = _merge(o, lse, oi.astype(jnp.float32), lsei)
+        # Rotate KV around the ring (neighbor -> neighbor over ICI).
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (o, lse, kc, vc), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v),
+                                     jnp.arange(sp))
+    return o.astype(q.dtype)
+
+
+def ring_attention_bshd(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                        axis_name: str = 'sp',
+                        causal: bool = True) -> jax.Array:
+    """[B, C, H, D]-layout convenience wrapper (model layout)."""
+    out = ring_attention(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                         jnp.swapaxes(v, 1, 2), axis_name=axis_name,
+                         causal=causal)
+    return jnp.swapaxes(out, 1, 2)

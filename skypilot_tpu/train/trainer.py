@@ -71,26 +71,39 @@ def default_optimizer(lr: float = 3e-4,
 def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
                     params_struct: Any, opt_state_struct: Any) -> TrainState:
     """NamedShardings for the whole TrainState. Optimizer moments (mu/nu in
-    adamw) are param-shaped copies of the param tree, so each opt-state
-    leaf inherits the spec of the param leaf with its shape; scalar leaves
-    (step counts) replicate."""
+    adamw) are structural copies of the param tree, so each opt-state leaf
+    inherits the spec of the param whose tree path its own path ends with
+    (path-suffix match — NOT shape match: wq and wo are identically shaped
+    but transposed-sharded). Scalar leaves (step counts) replicate."""
+    del params_struct
     pspecs = llama.param_shardings(cfg)
-    shape_to_spec = {}
-    for leaf, spec in zip(jax.tree.leaves(params_struct),
-                          jax.tree.leaves(pspecs)):
-        shape_to_spec[tuple(leaf.shape)] = spec
 
-    def to_sharding(spec):
-        return NamedSharding(mesh, spec)
+    def _path_key(path) -> tuple:
+        out = []
+        for p in path:
+            key = getattr(p, 'key', None)
+            out.append(str(key if key is not None else
+                           getattr(p, 'idx', p)))
+        return tuple(out)
 
-    def opt_leaf_sharding(leaf):
-        spec = shape_to_spec.get(tuple(getattr(leaf, 'shape', ())), P())
-        return NamedSharding(mesh, spec)
+    spec_by_path = {}
+    for path, spec in jax.tree_util.tree_flatten_with_path(pspecs)[0]:
+        spec_by_path[_path_key(path)] = spec
+
+    def opt_leaf_sharding(path, leaf):
+        del leaf
+        key = _path_key(path)
+        for i in range(len(key)):
+            spec = spec_by_path.get(key[i:])
+            if spec is not None:
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
 
     return TrainState(
         step=NamedSharding(mesh, P()),
-        params=jax.tree.map(to_sharding, pspecs),
-        opt_state=jax.tree.map(opt_leaf_sharding, opt_state_struct))
+        params=jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs),
+        opt_state=jax.tree_util.tree_map_with_path(opt_leaf_sharding,
+                                                   opt_state_struct))
 
 
 def init_train_state(cfg: llama.LlamaConfig, mesh: Mesh,

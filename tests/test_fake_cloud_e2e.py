@@ -213,3 +213,13 @@ def test_exec_smaller_task_on_bigger_cluster():
     assert _wait_job('sub', job2) == 'SUCCEEDED'
     log = _rank_log('sub', job2, 'run', 0)
     assert 'small' in log
+
+
+def test_resume_rejects_oversized_task():
+    """Launching a bigger task onto a STOPPED cluster fails upfront, not
+    after resuming the wrong-size cluster (review regression)."""
+    sky.launch(_task('true'), cluster_name='rsz', quiet_optimizer=True)
+    core.stop('rsz')
+    with pytest.raises(exceptions.ResourcesMismatchError):
+        sky.launch(_task('true', nodes=2), cluster_name='rsz',
+                   quiet_optimizer=True)

@@ -27,11 +27,15 @@ def test_param_shardings_applied(setup):
     spec = state.params['layers']['wq'].sharding.spec
     assert spec == P(None, 'fsdp', 'tp')
     assert state.step.sharding.spec == P()
-    # adam moments follow their params: find a wq-shaped opt leaf.
-    wq_shape = state.params['layers']['wq'].shape
-    moment_specs = {l.sharding.spec for l in jax.tree.leaves(state.opt_state)
-                    if getattr(l, 'shape', None) == wq_shape}
-    assert moment_specs == {P(None, 'fsdp', 'tp')}
+    # adam moments follow their params by tree path.
+    wq_specs = []
+    def visit(path, leaf):
+        if 'wq' in [getattr(p, 'key', None) for p in path] \
+                and hasattr(leaf, 'sharding'):
+            wq_specs.append(leaf.sharding.spec)
+        return leaf
+    jax.tree_util.tree_map_with_path(visit, state.opt_state)
+    assert wq_specs and set(wq_specs) == {P(None, 'fsdp', 'tp')}
 
 
 def test_loss_decreases_memorization(setup):
@@ -81,3 +85,18 @@ def test_mesh_validation():
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_wo_moments_not_shadowed_by_wq(setup):
+    """wq/wo are same-shaped but transposed-sharded; opt moments must match
+    by tree path, not shape (review regression)."""
+    cfg, mesh, state, _ = setup
+    P = jax.sharding.PartitionSpec
+    found = []
+    def visit(path, leaf):
+        names = [getattr(p, 'key', None) for p in path]
+        if 'wo' in names and hasattr(leaf, 'sharding'):
+            found.append(leaf.sharding.spec)
+        return leaf
+    jax.tree_util.tree_map_with_path(visit, state.opt_state)
+    assert found and set(found) == {P(None, 'tp', 'fsdp')}
