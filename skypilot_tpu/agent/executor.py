@@ -247,16 +247,18 @@ class GangExecutor:
 def spawn_detached(job_id: int) -> None:
     """Launch the executor as a daemonized process surviving the submit
     SSH session (reference analog: `ray job submit` detachment)."""
-    subprocess.Popen(
-        [sys.executable, '-m', 'skypilot_tpu.agent.executor', str(job_id)],
-        stdout=open(os.path.join(job_lib.log_dir(job_id), 'driver.log'),
-                    'ab'),
-        stderr=subprocess.STDOUT,
-        stdin=subprocess.DEVNULL,
-        start_new_session=True,
-        env={**os.environ,
-             'PYTHONPATH': os.path.expanduser(constants.RUNTIME_DIR) +
-             os.pathsep + os.environ.get('PYTHONPATH', '')})
+    with open(os.path.join(job_lib.log_dir(job_id), 'driver.log'),
+              'ab') as log_f:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.agent.executor',
+             str(job_id)],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL,
+            start_new_session=True,
+            env={**os.environ,
+                 'PYTHONPATH': os.path.expanduser(constants.RUNTIME_DIR) +
+                 os.pathsep + os.environ.get('PYTHONPATH', '')})
 
 
 def main() -> None:

@@ -1,0 +1,414 @@
+"""`skyt` CLI (reference: sky/cli.py, 5551 LoC of click commands).
+
+Verbs mirror the reference so SkyPilot users can switch without relearning:
+launch, exec, status, queue, logs, cancel, stop, start, down, autostop,
+check, show-tpus, cost-report, jobs {launch,queue,cancel,logs}, serve
+{up,status,down}, storage {ls,delete}, bench.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import click
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _parse_env(env: Tuple[str, ...]) -> Dict[str, str]:
+    out = {}
+    for item in env:
+        if '=' not in item:
+            raise click.UsageError(f'--env expects K=V, got {item!r}')
+        k, v = item.split('=', 1)
+        out[k] = v
+    return out
+
+
+def _load_task(entrypoint: str, env: Tuple[str, ...],
+               overrides: Dict[str, object]):
+    """Build a Task from a YAML path or inline command, applying CLI
+    resource overrides (reference: _make_task_or_dag_from_entrypoint...,
+    cli.py:722)."""
+    from skypilot_tpu import Resources, Task
+    env_overrides = _parse_env(env)
+    if os.path.isfile(entrypoint):
+        task = Task.from_yaml(entrypoint, env_overrides or None)
+    else:
+        task = Task(run=entrypoint, envs=env_overrides)
+    res_overrides = {k: v for k, v in overrides.items() if v is not None}
+    if res_overrides:
+        cfg = task.resources.to_yaml_config()
+        cfg.update(res_overrides)
+        task.resources = Resources.from_yaml_config(cfg)
+    return task
+
+
+def _fmt_age(ts: Optional[float]) -> str:
+    import time
+    if not ts:
+        return '-'
+    mins = (time.time() - ts) / 60
+    if mins < 60:
+        return f'{int(mins)}m'
+    if mins < 60 * 24:
+        return f'{mins / 60:.0f}h'
+    return f'{mins / 1440:.0f}d'
+
+
+def _table(header: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return '  '.join(header)
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(r, widths)))
+    return '\n'.join(lines)
+
+
+_RESOURCE_OPTS = [
+    click.option('--gpus', '--accelerators', 'accelerators', default=None,
+                 help='TPU type, e.g. tpu-v5e-8 (name kept for reference '
+                 'compat).'),
+    click.option('--cloud', default=None),
+    click.option('--region', default=None),
+    click.option('--zone', default=None),
+    click.option('--use-spot/--no-use-spot', default=None),
+    click.option('--cpus', default=None),
+    click.option('--num-nodes', type=int, default=None),
+]
+
+
+def _apply_resource_opts(fn):
+    for opt in reversed(_RESOURCE_OPTS):
+        fn = opt(fn)
+    return fn
+
+
+@click.group()
+def cli():
+    """skypilot_tpu: run AI workloads on TPU pods."""
+
+
+# ------------------------------------------------------------------ #
+# Cluster verbs
+# ------------------------------------------------------------------ #
+
+@cli.command()
+@click.argument('entrypoint')
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--env', multiple=True, help='K=V env overrides.')
+@click.option('--detach-run', '-d', is_flag=True)
+@click.option('--dryrun', is_flag=True)
+@click.option('--down', is_flag=True,
+              help='Tear down the cluster when the job finishes.')
+@click.option('--yes', '-y', is_flag=True)
+@_apply_resource_opts
+def launch(entrypoint, cluster, env, detach_run, dryrun, down, yes,
+           accelerators, cloud, region, zone, use_spot, cpus, num_nodes):
+    """Provision (or reuse) a cluster and run ENTRYPOINT (YAML or cmd)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import dag as dag_lib, optimizer
+    task = _load_task(entrypoint, env, {
+        'accelerators': accelerators, 'cloud': cloud, 'region': region,
+        'zone': zone, 'use_spot': use_spot, 'cpus': cpus})
+    if num_nodes is not None:
+        task.num_nodes = num_nodes
+    plan = optimizer.optimize(dag_lib.to_dag(task), quiet=True)[0]
+    print(optimizer.format_plan_table([plan]))
+    if not yes and not dryrun and sys.stdin.isatty():
+        click.confirm('Launch?', abort=True, default=True)
+    job_id, handle = sky.launch(task, cluster_name=cluster, dryrun=dryrun,
+                                detach_run=detach_run, down=down,
+                                quiet_optimizer=True)
+    if handle is not None and job_id is not None:
+        print(f'Job {job_id} on cluster {handle.cluster_name!r}. '
+              f'Logs: skyt logs {handle.cluster_name} {job_id}')
+
+
+@cli.command(name='exec')
+@click.argument('cluster')
+@click.argument('entrypoint')
+@click.option('--env', multiple=True)
+@click.option('--detach-run', '-d', is_flag=True)
+def exec_cmd(cluster, entrypoint, env, detach_run):
+    """Run ENTRYPOINT on an existing cluster (no provisioning)."""
+    import skypilot_tpu as sky
+    task = _load_task(entrypoint, env, {})
+    job_id, _ = sky.exec(task, cluster_name=cluster, detach_run=detach_run)
+    if detach_run and job_id is not None:
+        print(f'Job {job_id} submitted. Logs: skyt logs {cluster} {job_id}')
+
+
+@cli.command()
+@click.option('--refresh', '-r', is_flag=True,
+              help='Reconcile with the cloud before printing.')
+def status(refresh):
+    """Cluster table (reference: `sky status [-r]`)."""
+    from skypilot_tpu import core
+    records = core.status(refresh=refresh)
+    rows = []
+    for r in records:
+        handle = r['handle']
+        res = str(handle.launched_resources) if handle else '-'
+        autostop = (f"{r['autostop']}m{'(down)' if r['to_down'] else ''}"
+                    if r['autostop'] >= 0 else '-')
+        rows.append([r['name'], _fmt_age(r['launched_at']),
+                     r['status'].value, res, autostop])
+    print(_table(['NAME', 'AGE', 'STATUS', 'RESOURCES', 'AUTOSTOP'], rows))
+
+
+@cli.command()
+@click.argument('cluster')
+def queue(cluster):
+    """Job queue of a cluster."""
+    from skypilot_tpu import core
+    jobs = core.queue(cluster)
+    rows = [[str(j['job_id']), j['name'], j['status'],
+             _fmt_age(j['submitted_at'])] for j in jobs]
+    print(_table(['ID', 'NAME', 'STATUS', 'SUBMITTED'], rows))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int)
+@click.option('--follow/--no-follow', default=True)
+@click.option('--sync-down', is_flag=True, help='Download instead of tail.')
+def logs(cluster, job_id, follow, sync_down):
+    """Tail (or download) a job's logs."""
+    from skypilot_tpu import core
+    if sync_down:
+        path = core.download_logs(cluster, job_id, f'./skyt_logs_{job_id}')
+        print(f'Logs downloaded to {path}')
+        return
+    sys.exit(core.tail_logs(cluster, job_id, follow=follow))
+
+
+@cli.command()
+@click.argument('cluster')
+@click.argument('job_id', type=int, required=False)
+@click.option('--all', 'all_jobs', is_flag=True)
+def cancel(cluster, job_id, all_jobs):
+    """Cancel a job (or --all)."""
+    from skypilot_tpu import core
+    if job_id is None and not all_jobs:
+        raise click.UsageError('Provide JOB_ID or --all.')
+    cancelled = core.cancel(cluster, None if all_jobs else job_id)
+    print(f'Cancelled: {cancelled or "nothing"}')
+
+
+@cli.command()
+@click.argument('cluster')
+def stop(cluster):
+    """Stop a (single-host) cluster; disks persist."""
+    from skypilot_tpu import core
+    core.stop(cluster)
+    print(f'Cluster {cluster!r} stopped.')
+
+
+@cli.command()
+@click.argument('cluster')
+def start(cluster):
+    """Restart a stopped cluster."""
+    from skypilot_tpu import core
+    core.start(cluster)
+    print(f'Cluster {cluster!r} is UP.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True)
+def down(clusters, yes):
+    """Terminate clusters."""
+    from skypilot_tpu import core
+    if not yes and sys.stdin.isatty():
+        click.confirm(f'Tear down {", ".join(clusters)}?', abort=True)
+    for name in clusters:
+        core.down(name)
+        print(f'Cluster {name!r} terminated.')
+
+
+@cli.command()
+@click.argument('cluster')
+@click.option('--idle-minutes', '-i', type=int, required=True)
+@click.option('--down', 'to_down', is_flag=True,
+              help='Tear down instead of stopping (required for pods).')
+@click.option('--cancel', 'cancel_flag', is_flag=True,
+              help='Disable autostop.')
+def autostop(cluster, idle_minutes, to_down, cancel_flag):
+    """Configure idle autostop/autodown."""
+    from skypilot_tpu import core
+    if cancel_flag:
+        idle_minutes = -1
+    core.autostop(cluster, idle_minutes, to_down)
+    print(f'Autostop for {cluster!r}: '
+          f'{"off" if idle_minutes < 0 else f"{idle_minutes}m"}'
+          f'{" (down)" if to_down else ""}')
+
+
+# ------------------------------------------------------------------ #
+# Info verbs
+# ------------------------------------------------------------------ #
+
+@cli.command()
+def check():
+    """Probe cloud credentials and cache enabled clouds."""
+    from skypilot_tpu import check as check_lib
+    enabled = check_lib.check()
+    if not enabled:
+        print('No clouds enabled. Configure GCP credentials '
+              '(gcloud auth application-default login).')
+        sys.exit(1)
+
+
+@cli.command(name='show-tpus')
+@click.argument('name_filter', required=False)
+def show_tpus(name_filter):
+    """TPU catalog: types, chips/hosts, price (reference: show-gpus)."""
+    from skypilot_tpu import catalog
+    accs = catalog.list_accelerators(name_filter)
+    rows = []
+    for name in sorted(accs, key=lambda t: (t.rsplit('-', 1)[0],
+                                            int(t.rsplit('-', 1)[1]))):
+        offs = accs[name]
+        o = offs[0]
+        zones = ', '.join(dict.fromkeys(x.zone for x in offs[:3]))
+        if len(offs) > 3:
+            zones += f', +{len(offs) - 3}'
+        rows.append([f'tpu-{name}', str(o.topology.num_chips),
+                     str(o.topology.num_hosts), f'${o.price_hr:.2f}',
+                     f'${o.spot_price_hr:.2f}', zones])
+    print(_table(['TPU', 'CHIPS', 'HOSTS', '$/HR', 'SPOT$/HR',
+                  'ZONES'], rows))
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Accumulated cost per cluster from usage history."""
+    from skypilot_tpu import core
+    rows = [[r['name'], r['resources'][:40], str(r['num_nodes']),
+             f"{r['duration_hours']:.2f}h", f"${r['cost']:.2f}"]
+            for r in core.cost_report()]
+    print(_table(['NAME', 'RESOURCES', 'NODES', 'DURATION', 'COST'], rows))
+
+
+# ------------------------------------------------------------------ #
+# Managed jobs / serve / storage groups (filled by their subsystems)
+# ------------------------------------------------------------------ #
+
+@cli.group()
+def jobs():
+    """Managed jobs with automatic recovery."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint')
+@click.option('--name', '-n', default=None)
+@click.option('--env', multiple=True)
+@click.option('--yes', '-y', is_flag=True)
+def jobs_launch(entrypoint, name, env, yes):
+    from skypilot_tpu.jobs import core as jobs_core
+    task = _load_task(entrypoint, env, {})
+    if name:
+        task.name = name
+    jobs_core.launch(task, name=name)
+
+
+@jobs.command(name='queue')
+def jobs_queue():
+    from skypilot_tpu.jobs import core as jobs_core
+    rows = [[str(j['job_id']), j['name'], j['status'],
+             str(j.get('recoveries', 0)), _fmt_age(j.get('submitted_at'))]
+            for j in jobs_core.queue()]
+    print(_table(['ID', 'NAME', 'STATUS', 'RECOVERIES', 'SUBMITTED'],
+                 rows))
+
+
+@jobs.command(name='cancel')
+@click.argument('job_id', type=int)
+def jobs_cancel(job_id):
+    from skypilot_tpu.jobs import core as jobs_core
+    jobs_core.cancel(job_id)
+    print(f'Managed job {job_id} cancel requested.')
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', type=int)
+@click.option('--follow/--no-follow', default=True)
+def jobs_logs(job_id, follow):
+    from skypilot_tpu.jobs import core as jobs_core
+    sys.exit(jobs_core.tail_logs(job_id, follow=follow))
+
+
+@cli.group()
+def serve():
+    """Serving with replica autoscaling."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint')
+@click.option('--service-name', '-n', default=None)
+@click.option('--yes', '-y', is_flag=True)
+def serve_up(entrypoint, service_name, yes):
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu import Task
+    task = Task.from_yaml(entrypoint)
+    serve_core.up(task, service_name=service_name)
+
+
+@serve.command(name='status')
+@click.argument('service_name', required=False)
+def serve_status(service_name):
+    from skypilot_tpu.serve import core as serve_core
+    for svc in serve_core.status(service_name):
+        print(svc)
+
+
+@serve.command(name='down')
+@click.argument('service_name')
+@click.option('--yes', '-y', is_flag=True)
+def serve_down(service_name, yes):
+    from skypilot_tpu.serve import core as serve_core
+    serve_core.down(service_name)
+    print(f'Service {service_name!r} torn down.')
+
+
+@cli.group()
+def storage():
+    """Bucket lifecycle."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    from skypilot_tpu import global_user_state
+    rows = [[s['name'], s['status'], _fmt_age(s['launched_at'])]
+            for s in global_user_state.get_storage()]
+    print(_table(['NAME', 'STATUS', 'AGE'], rows))
+
+
+@storage.command(name='delete')
+@click.argument('name')
+@click.option('--yes', '-y', is_flag=True)
+def storage_delete(name, yes):
+    from skypilot_tpu.data import storage as storage_lib
+    storage_lib.delete_storage(name)
+    print(f'Storage {name!r} deleted.')
+
+
+def main():
+    try:
+        cli(standalone_mode=True)
+    except Exception as e:  # noqa: BLE001 — user-facing error formatting
+        from skypilot_tpu import exceptions
+        if isinstance(e, exceptions.SkyTpuError):
+            print(f'\x1b[31mError:\x1b[0m {e}', file=sys.stderr)
+            sys.exit(1)
+        raise
+
+
+if __name__ == '__main__':
+    main()

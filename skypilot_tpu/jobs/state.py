@@ -1,0 +1,137 @@
+"""Managed-jobs state DB (reference: sky/jobs/state.py, 1095 LoC).
+
+SQLite under SKYT_HOME (local-controller mode) or the controller VM's home
+(controller-VM mode) — the schema is the same either way.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+
+
+class ManagedJobStatus(enum.Enum):
+    """Reference: sky/jobs/state.py:187."""
+    PENDING = 'PENDING'
+    SUBMITTED = 'SUBMITTED'
+    STARTING = 'STARTING'
+    RUNNING = 'RUNNING'
+    RECOVERING = 'RECOVERING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'
+    CANCELLING = 'CANCELLING'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (
+            ManagedJobStatus.SUCCEEDED, ManagedJobStatus.FAILED,
+            ManagedJobStatus.FAILED_SETUP,
+            ManagedJobStatus.FAILED_NO_RESOURCE,
+            ManagedJobStatus.FAILED_CONTROLLER, ManagedJobStatus.CANCELLED)
+
+
+def _db_path() -> str:
+    return str(config_lib.home_dir() / 'managed_jobs.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS managed_jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            dag_yaml TEXT,
+            status TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            recoveries INTEGER DEFAULT 0,
+            controller_pid INTEGER,
+            cluster_name TEXT,
+            log_path TEXT,
+            failure_reason TEXT)
+    """)
+    return conn
+
+
+def add_job(name: str, dag_yaml: str, log_path: str) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO managed_jobs (name, dag_yaml, status,'
+            ' submitted_at, log_path) VALUES (?,?,?,?,?)',
+            (name, dag_yaml, ManagedJobStatus.PENDING.value, time.time(),
+             log_path))
+        return cur.lastrowid
+
+
+def set_status(job_id: int, status: ManagedJobStatus,
+               failure_reason: Optional[str] = None) -> None:
+    with _conn() as conn:
+        if status == ManagedJobStatus.RUNNING:
+            conn.execute(
+                'UPDATE managed_jobs SET status=?, started_at='
+                'COALESCE(started_at, ?) WHERE job_id=?',
+                (status.value, time.time(), job_id))
+        elif status.is_terminal():
+            conn.execute(
+                'UPDATE managed_jobs SET status=?, ended_at=?, '
+                'failure_reason=COALESCE(?, failure_reason) WHERE job_id=?',
+                (status.value, time.time(), failure_reason, job_id))
+        else:
+            conn.execute('UPDATE managed_jobs SET status=? WHERE job_id=?',
+                         (status.value, job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET controller_pid=? '
+                     'WHERE job_id=?', (pid, job_id))
+
+
+def set_cluster_name(job_id: int, cluster_name: str) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET cluster_name=? '
+                     'WHERE job_id=?', (cluster_name, job_id))
+
+
+def bump_recoveries(job_id: int) -> int:
+    with _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET recoveries=recoveries+1 '
+                     'WHERE job_id=?', (job_id,))
+        row = conn.execute('SELECT recoveries FROM managed_jobs '
+                           'WHERE job_id=?', (job_id,)).fetchone()
+        return row[0]
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    row = _conn().execute(
+        'SELECT job_id, name, dag_yaml, status, submitted_at, started_at,'
+        ' ended_at, recoveries, controller_pid, cluster_name, log_path,'
+        ' failure_reason FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()
+    return _row(row) if row else None
+
+
+def get_jobs() -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT job_id, name, dag_yaml, status, submitted_at, started_at,'
+        ' ended_at, recoveries, controller_pid, cluster_name, log_path,'
+        ' failure_reason FROM managed_jobs ORDER BY job_id DESC').fetchall()
+    return [_row(r) for r in rows]
+
+
+def _row(row) -> Dict[str, Any]:
+    return {
+        'job_id': row[0], 'name': row[1], 'dag_yaml': row[2],
+        'status': ManagedJobStatus(row[3]), 'submitted_at': row[4],
+        'started_at': row[5], 'ended_at': row[6], 'recoveries': row[7],
+        'controller_pid': row[8], 'cluster_name': row[9],
+        'log_path': row[10], 'failure_reason': row[11],
+    }

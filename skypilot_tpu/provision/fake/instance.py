@@ -181,9 +181,64 @@ def stop_instances(cluster_name: str,
 
 def terminate_instances(cluster_name: str,
                         provider_config: Optional[Dict] = None) -> None:
+    import time
     d = _cluster_dir(cluster_name)
+    # Kill + delete with retries: executors/daemons may still be writing
+    # logs while the tree is being removed.
+    for attempt in range(5):
+        if not d.exists():
+            return
+        _kill_host_processes(d)
+        try:
+            shutil.rmtree(d)
+            return
+        except OSError:
+            time.sleep(0.2 * (attempt + 1))
     if d.exists():
-        shutil.rmtree(d)
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _kill_host_processes(cluster_dir: pathlib.Path) -> None:
+    """Terminating a real TPU kills everything on it; the fake cloud must
+    match, or 'preempted' replica/job processes would keep running (and
+    keep answering readiness probes). Job pgids are recorded in the
+    executor's pidfiles; the daemon records its own."""
+    import signal
+    import sqlite3
+    pids = []
+    for pid_file in cluster_dir.rglob('*.pid'):
+        try:
+            pids.append(int(pid_file.read_text().strip()))
+        except (ValueError, OSError):
+            continue
+    # Gang executors record their pid in the head's jobs.db, not a file.
+    for db in cluster_dir.rglob('.skyt_agent/jobs.db'):
+        try:
+            conn = sqlite3.connect(db)
+            # Only live jobs: a finished executor's PID may have been
+            # recycled by the OS for an unrelated process.
+            rows = conn.execute(
+                "SELECT executor_pid FROM jobs WHERE executor_pid IS NOT "
+                "NULL AND status IN ('PENDING','SETTING_UP','RUNNING')"
+            ).fetchall()
+            conn.close()
+            pids.extend(r[0] for r in rows)
+        except sqlite3.Error:
+            continue
+    own_pgid = os.getpgid(0)
+    for pid in pids:
+        try:
+            pgid = os.getpgid(pid)
+        except (ProcessLookupError, PermissionError):
+            continue
+        try:
+            if pgid == pid and pgid != own_pgid:
+                # setsid'd job tree: kill the whole group.
+                os.killpg(pgid, signal.SIGKILL)
+            elif pid != os.getpid():
+                os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
 
 def query_instances(cluster_name: str,

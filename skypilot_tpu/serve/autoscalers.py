@@ -1,0 +1,69 @@
+"""Autoscalers (reference: sky/serve/autoscalers.py, 696 LoC).
+
+`RequestRateAutoscaler` with hysteresis: desired = ceil(qps /
+target_qps_per_replica) clamped to [min, max]; a scale decision only fires
+after the signal persists for upscale/downscale_delay_seconds (reference
+_AutoscalerWithHysteresis :348, RequestRateAutoscaler :431).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Deque, List, Optional
+
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+QPS_WINDOW_SECONDS = 60.0
+
+
+@dataclasses.dataclass
+class ScalingDecision:
+    target_num_replicas: int
+
+
+class RequestRateAutoscaler:
+
+    def __init__(self, spec: SkyServiceSpec,
+                 tick_seconds: float = 10.0,
+                 qps_window_seconds: float = QPS_WINDOW_SECONDS) -> None:
+        self.spec = spec
+        self.qps_window_seconds = qps_window_seconds
+        self.target = spec.min_replicas
+        self._upscale_ticks_needed = max(
+            1, int(spec.upscale_delay_seconds / tick_seconds))
+        self._downscale_ticks_needed = max(
+            1, int(spec.downscale_delay_seconds / tick_seconds))
+        self._upscale_counter = 0
+        self._downscale_counter = 0
+
+    def current_qps(self, request_timestamps: List[float]) -> float:
+        cutoff = time.time() - self.qps_window_seconds
+        recent = [t for t in request_timestamps if t >= cutoff]
+        return len(recent) / self.qps_window_seconds
+
+    def evaluate(self, request_timestamps: List[float]) -> ScalingDecision:
+        spec = self.spec
+        if spec.target_qps_per_replica is None:
+            self.target = spec.min_replicas
+            return ScalingDecision(self.target)
+        qps = self.current_qps(request_timestamps)
+        desired = max(spec.min_replicas,
+                      min(spec.max_replicas,
+                          math.ceil(qps / spec.target_qps_per_replica)))
+        if desired > self.target:
+            self._upscale_counter += 1
+            self._downscale_counter = 0
+            if self._upscale_counter >= self._upscale_ticks_needed:
+                self.target = desired
+                self._upscale_counter = 0
+        elif desired < self.target:
+            self._downscale_counter += 1
+            self._upscale_counter = 0
+            if self._downscale_counter >= self._downscale_ticks_needed:
+                self.target = desired
+                self._downscale_counter = 0
+        else:
+            self._upscale_counter = 0
+            self._downscale_counter = 0
+        return ScalingDecision(self.target)

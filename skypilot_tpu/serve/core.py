@@ -1,0 +1,91 @@
+"""Serve client API: up/status/down (reference: sky/serve/core.py)."""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task YAML needs a `service:` section for serve up.')
+    name = service_name or task.name or 'service'
+    if state.get_service(name) is not None:
+        raise exceptions.SkyTpuError(
+            f'Service {name!r} already exists; use a different name or '
+            f'`skyt serve down {name}` first.')
+    svc_dir = config_lib.home_dir() / 'serve' / name
+    svc_dir.mkdir(parents=True, exist_ok=True)
+    task_yaml = str(svc_dir / 'task.yaml')
+    task.to_yaml(task_yaml)
+    log_path = str(svc_dir / 'controller.log')
+
+    state.add_service(name, json.dumps(task.service.to_yaml_config()))
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.service',
+             '--service-name', name, '--task-yaml', task_yaml],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    logger.info(f'Service {name!r} starting (controller pid {proc.pid}); '
+                f'endpoint will be 127.0.0.1:{task.service.port}.')
+    return name
+
+
+def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
+    services = ([state.get_service(service_name)]
+                if service_name else state.get_services())
+    out = []
+    for svc in services:
+        if svc is None:
+            continue
+        svc = dict(svc)
+        svc['replicas'] = state.get_replicas(svc['name'])
+        out.append(svc)
+    return out
+
+
+def down(service_name: str, timeout: float = 120) -> None:
+    svc = state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} not found.')
+    pid = svc['controller_pid']
+    if pid:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if state.get_service(service_name) is None:
+                    return
+                time.sleep(0.5)
+            # Controller overran the graceful window: a live controller
+            # would keep replacing the replicas we're about to delete —
+            # kill it before the direct cleanup below.
+            logger.warning(f'Controller {pid} for {service_name!r} slow '
+                           f'to exit; killing.')
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    # Controller gone or slow: clean up replicas directly.
+    from skypilot_tpu import core, global_user_state
+    for replica in state.get_replicas(service_name):
+        if global_user_state.get_cluster(replica['cluster_name']):
+            try:
+                core.down(replica['cluster_name'])
+            except exceptions.SkyTpuError:
+                pass
+    state.remove_service(service_name)

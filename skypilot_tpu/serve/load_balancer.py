@@ -1,0 +1,139 @@
+"""HTTP load balancer (reference: sky/serve/load_balancer.py — FastAPI
+proxy with RoundRobin/LeastLoad policies; ours is stdlib
+ThreadingHTTPServer so the on-controller runtime has zero web-framework
+deps).
+"""
+from __future__ import annotations
+
+import http.client
+import http.server
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve.replica_managers import ReplicaInfo
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding',
+                'upgrade', 'proxy-authenticate', 'te', 'trailers'}
+
+
+class LoadBalancingPolicy:
+    def select(self, replicas: List[ReplicaInfo]) -> ReplicaInfo:
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(LoadBalancingPolicy):
+    def __init__(self) -> None:
+        self._counter = itertools.count()
+
+    def select(self, replicas: List[ReplicaInfo]) -> ReplicaInfo:
+        return replicas[next(self._counter) % len(replicas)]
+
+
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Default (reference: load_balancing_policies.py:115)."""
+
+    def select(self, replicas: List[ReplicaInfo]) -> ReplicaInfo:
+        return min(replicas, key=lambda r: r.active_requests)
+
+
+POLICIES = {'round_robin': RoundRobinPolicy, 'least_load': LeastLoadPolicy}
+
+
+class LoadBalancer:
+    """Reverse proxy on the service port. Records request timestamps for
+    the autoscaler's QPS window; retries across ready replicas
+    (reference: _proxy_with_retries :174)."""
+
+    def __init__(self, port: int,
+                 get_ready_replicas: Callable[[], List[ReplicaInfo]],
+                 policy: str = 'least_load',
+                 max_retries: int = 3) -> None:
+        self.port = port
+        self.get_ready_replicas = get_ready_replicas
+        self.policy = POLICIES[policy]()
+        self.max_retries = max_retries
+        self.request_timestamps: List[float] = []
+        self._ts_lock = threading.Lock()
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    def record_request(self) -> None:
+        now = time.time()
+        with self._ts_lock:
+            self.request_timestamps.append(now)
+            # Bound memory: drop entries older than 10 minutes.
+            cutoff = now - 600
+            while self.request_timestamps and \
+                    self.request_timestamps[0] < cutoff:
+                self.request_timestamps.pop(0)
+
+    def _proxy(self, handler: http.server.BaseHTTPRequestHandler) -> None:
+        self.record_request()
+        body = None
+        length = handler.headers.get('Content-Length')
+        if length:
+            body = handler.rfile.read(int(length))
+        last_error = 'no ready replicas'
+        for _ in range(self.max_retries):
+            replicas = self.get_ready_replicas()
+            if not replicas:
+                break
+            replica = self.policy.select(replicas)
+            replica.active_requests += 1
+            try:
+                host, port = replica.endpoint.split(':')
+                conn = http.client.HTTPConnection(host, int(port),
+                                                  timeout=60)
+                headers = {k: v for k, v in handler.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                conn.request(handler.command, handler.path, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+                handler.send_response(resp.status)
+                for k, v in resp.getheaders():
+                    if k.lower() not in _HOP_HEADERS and \
+                            k.lower() != 'content-length':
+                        handler.send_header(k, v)
+                handler.send_header('Content-Length', str(len(payload)))
+                handler.end_headers()
+                handler.wfile.write(payload)
+                conn.close()
+                return
+            except Exception as e:  # noqa: BLE001 — retry next replica
+                last_error = str(e)
+            finally:
+                replica.active_requests -= 1
+        handler.send_response(503)
+        msg = f'No ready replicas ({last_error})'.encode()
+        handler.send_header('Content-Length', str(len(msg)))
+        handler.end_headers()
+        handler.wfile.write(msg)
+
+    def serve_forever_in_thread(self) -> threading.Thread:
+        lb = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def _do(self):
+                lb._proxy(self)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _do
+
+        self._server = http.server.ThreadingHTTPServer(
+            ('0.0.0.0', self.port), Handler)
+        thread = threading.Thread(target=self._server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        logger.info(f'Load balancer listening on :{self.port}')
+        return thread
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

@@ -1,0 +1,188 @@
+"""Replica manager (reference: sky/serve/replica_managers.py, 1240 LoC —
+SkyPilotReplicaManager: launch/terminate replica clusters + readiness
+probing threads).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+logger = sky_logging.init_logger(__name__)
+
+PROBE_FAILURE_THRESHOLD = 3
+
+
+class ReplicaInfo:
+    def __init__(self, replica_id: int, cluster_name: str,
+                 port: int) -> None:
+        self.replica_id = replica_id
+        self.cluster_name = cluster_name
+        self.port = port
+        self.status = state.ReplicaStatus.PROVISIONING
+        self.endpoint: Optional[str] = None
+        self.consecutive_failures = 0
+        self.first_ready_probe_at: Optional[float] = None
+        self.launched_at = time.time()
+        self.active_requests = 0   # LeastLoad policy counter (LB-owned)
+
+
+class ReplicaManager:
+    """Launch/terminate/probe replicas. Each replica is a full cluster
+    launch (recursion into the launch stack, like the reference's
+    _launch_replica via sky.launch, replica_managers.py:643)."""
+
+    def __init__(self, service_name: str, task: task_lib.Task,
+                 spec: SkyServiceSpec) -> None:
+        self.service_name = service_name
+        self.task = task
+        self.spec = spec
+        self.replicas: Dict[int, ReplicaInfo] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+
+    # -------------------------------------------------------------- #
+
+    def _replica_port(self, replica_id: int) -> int:
+        # On the fake (localhost) cloud every replica shares the host, so
+        # each gets a unique port; real clouds use the spec port.
+        if (self.task.resources.cloud or 'gcp') == 'fake':
+            return self.spec.port + replica_id
+        return self.spec.port
+
+    def scale_up(self) -> None:
+        with self._lock:
+            replica_id = self._next_id
+            self._next_id += 1
+            cluster = f'skyt-serve-{self.service_name}-{replica_id}'
+            info = ReplicaInfo(replica_id, cluster,
+                               self._replica_port(replica_id))
+            self.replicas[replica_id] = info
+        state.upsert_replica(self.service_name, replica_id, cluster,
+                             state.ReplicaStatus.PROVISIONING, None)
+        t = threading.Thread(target=self._launch_replica, args=(info,),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _launch_replica(self, info: ReplicaInfo) -> None:
+        import copy
+        replica_task = task_lib.Task(
+            name=f'{self.service_name}-r{info.replica_id}',
+            run=self.task.run, setup=self.task.setup,
+            envs={**self.task.envs,
+                  'SKYT_REPLICA_PORT': str(info.port),
+                  'SKYT_REPLICA_ID': str(info.replica_id)},
+            workdir=self.task.workdir,
+            file_mounts=dict(self.task.file_mounts),
+        )
+        replica_task.resources = copy.copy(self.task.resources)
+        try:
+            _, handle = execution.launch(replica_task,
+                                         cluster_name=info.cluster_name,
+                                         detach_run=True,
+                                         quiet_optimizer=True)
+            head = handle.cluster_info.head_instance
+            ip = head.external_ip or head.internal_ip
+            info.endpoint = f'{ip}:{info.port}'
+            info.status = state.ReplicaStatus.STARTING
+        except exceptions.SkyTpuError as e:
+            logger.warning(f'replica {info.replica_id} launch failed: {e}')
+            info.status = state.ReplicaStatus.FAILED
+        state.upsert_replica(self.service_name, info.replica_id,
+                             info.cluster_name, info.status, info.endpoint)
+
+    def scale_down(self, replica_id: int) -> None:
+        with self._lock:
+            info = self.replicas.pop(replica_id, None)
+        if info is None:
+            return
+        info.status = state.ReplicaStatus.SHUTTING_DOWN
+        state.upsert_replica(self.service_name, replica_id,
+                             info.cluster_name, info.status, info.endpoint)
+        t = threading.Thread(target=self._terminate_replica, args=(info,),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _terminate_replica(self, info: ReplicaInfo) -> None:
+        from skypilot_tpu import core
+        try:
+            core.down(info.cluster_name)
+        except exceptions.ClusterDoesNotExist:
+            pass
+        state.remove_replica(self.service_name, info.replica_id)
+
+    def terminate_all(self) -> None:
+        with self._lock:
+            ids = list(self.replicas)
+        for rid in ids:
+            self.scale_down(rid)
+        for t in self._threads:
+            t.join(timeout=60)
+
+    # -------------------------------------------------------------- #
+
+    def probe_all(self) -> None:
+        """One readiness sweep (reference: _replica_prober :1026-1130)."""
+        for info in list(self.replicas.values()):
+            if info.status not in (state.ReplicaStatus.STARTING,
+                                   state.ReplicaStatus.READY,
+                                   state.ReplicaStatus.NOT_READY):
+                continue
+            if info.endpoint is None:
+                continue
+            in_grace = (time.time() - info.launched_at <
+                        self.spec.initial_delay_seconds)
+            ok = self._probe_one(info)
+            if ok:
+                info.consecutive_failures = 0
+                if info.status != state.ReplicaStatus.READY:
+                    logger.info(f'replica {info.replica_id} READY at '
+                                f'{info.endpoint}')
+                info.status = state.ReplicaStatus.READY
+            elif not in_grace:
+                info.consecutive_failures += 1
+                if info.consecutive_failures >= PROBE_FAILURE_THRESHOLD:
+                    logger.warning(
+                        f'replica {info.replica_id} failed '
+                        f'{info.consecutive_failures} probes; replacing.')
+                    self.scale_down(info.replica_id)
+                    self.scale_up()
+                    continue
+                if info.status == state.ReplicaStatus.READY:
+                    info.status = state.ReplicaStatus.NOT_READY
+            state.upsert_replica(self.service_name, info.replica_id,
+                                 info.cluster_name, info.status,
+                                 info.endpoint)
+
+    def _probe_one(self, info: ReplicaInfo) -> bool:
+        url = f'http://{info.endpoint}{self.spec.readiness_path}'
+        try:
+            data = (self.spec.post_data.encode()
+                    if self.spec.post_data else None)
+            req = urllib.request.Request(url, data=data)
+            with urllib.request.urlopen(
+                    req, timeout=self.spec.readiness_timeout_seconds) as r:
+                return 200 <= r.status < 300
+        except Exception:  # noqa: BLE001 — any failure is "not ready"
+            return False
+
+    def ready_replicas(self) -> List[ReplicaInfo]:
+        return [i for i in self.replicas.values()
+                if i.status == state.ReplicaStatus.READY]
+
+    @property
+    def num_alive(self) -> int:
+        return len([i for i in self.replicas.values()
+                    if i.status != state.ReplicaStatus.FAILED])

@@ -1,0 +1,100 @@
+"""The per-service controller process (reference: sky/serve/service.py
+_start :133 — controller + load-balancer processes on the controller VM;
+ours is one process with an autoscaler/prober loop thread + the LB server).
+
+Run detached: `python -m skypilot_tpu.serve.service --service-name X
+--task-yaml path`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import state
+
+logger = sky_logging.init_logger(__name__)
+
+TICK_SECONDS = float(os.environ.get('SKYT_SERVE_TICK_SECONDS', '10'))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service-name', required=True)
+    parser.add_argument('--task-yaml', required=True)
+    args = parser.parse_args()
+    name = args.service_name
+
+    task = task_lib.Task.from_yaml(args.task_yaml)
+    spec = task.service
+    assert spec is not None, 'task has no service section'
+
+    manager = replica_managers.ReplicaManager(name, task, spec)
+    autoscaler = autoscalers.RequestRateAutoscaler(
+        spec, tick_seconds=TICK_SECONDS)
+    lb = lb_lib.LoadBalancer(spec.port, manager.ready_replicas,
+                             policy=spec.load_balancing_policy)
+
+    state.set_service(name, status=state.ServiceStatus.REPLICA_INIT,
+                      controller_pid=os.getpid(),
+                      endpoint=f'127.0.0.1:{spec.port}')
+
+    shutting_down = {'flag': False}
+
+    def _on_term(signum, frame):
+        del signum, frame
+        if shutting_down['flag']:
+            return
+        shutting_down['flag'] = True
+        state.set_service(name, status=state.ServiceStatus.SHUTTING_DOWN)
+        lb.shutdown()
+        manager.terminate_all()
+        state.remove_service(name)
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    for _ in range(spec.min_replicas):
+        manager.scale_up()
+    lb.serve_forever_in_thread()
+
+    while True:
+        time.sleep(TICK_SECONDS)
+        try:
+            manager.probe_all()
+            decision = autoscaler.evaluate(lb.request_timestamps)
+            alive = manager.num_alive
+            if decision.target_num_replicas > alive:
+                for _ in range(decision.target_num_replicas - alive):
+                    manager.scale_up()
+            elif decision.target_num_replicas < alive:
+                # Shed not-ready first, then the newest (highest-id) READY
+                # replicas — keep the oldest, warmed ones. FAILED replicas
+                # aren't in the alive count, so they don't consume excess.
+                candidates = sorted(
+                    (i for i in manager.replicas.values()
+                     if i.status != state.ReplicaStatus.FAILED),
+                    key=lambda i: (i.status == state.ReplicaStatus.READY,
+                                   -i.replica_id))
+                excess = alive - decision.target_num_replicas
+                for info in candidates[:excess]:
+                    manager.scale_down(info.replica_id)
+            ready = len(manager.ready_replicas())
+            status = (state.ServiceStatus.READY if ready > 0
+                      else state.ServiceStatus.REPLICA_INIT)
+            state.set_service(name, status=status)
+        except Exception as e:  # noqa: BLE001 — controller must survive
+            logger.error(f'controller tick error: {e}')
+
+
+if __name__ == '__main__':
+    main()

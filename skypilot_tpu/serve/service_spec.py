@@ -1,0 +1,94 @@
+"""Service spec (reference: sky/serve/service_spec.py, 385 LoC)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.utils import schemas
+
+
+@dataclasses.dataclass
+class SkyServiceSpec:
+    readiness_path: str = '/'
+    initial_delay_seconds: int = 60
+    readiness_timeout_seconds: int = 15
+    post_data: Optional[str] = None
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: int = 300
+    downscale_delay_seconds: int = 1200
+    port: int = 8080
+    load_balancing_policy: str = 'least_load'
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
+        schemas.validate_service_config(config)
+        spec = cls()
+        probe = config.get('readiness_probe')
+        if isinstance(probe, str):
+            spec.readiness_path = probe
+        elif isinstance(probe, dict):
+            spec.readiness_path = probe.get('path', '/')
+            spec.initial_delay_seconds = int(
+                probe.get('initial_delay_seconds', 60))
+            spec.readiness_timeout_seconds = int(
+                probe.get('timeout_seconds', 15))
+            spec.post_data = probe.get('post_data')
+        policy = config.get('replica_policy')
+        if policy:
+            spec.min_replicas = int(policy.get('min_replicas', 1))
+            if policy.get('max_replicas') is not None:
+                spec.max_replicas = int(policy['max_replicas'])
+            if policy.get('target_qps_per_replica') is not None:
+                spec.target_qps_per_replica = float(
+                    policy['target_qps_per_replica'])
+            spec.upscale_delay_seconds = int(
+                policy.get('upscale_delay_seconds', 300))
+            spec.downscale_delay_seconds = int(
+                policy.get('downscale_delay_seconds', 1200))
+        elif config.get('replicas') is not None:
+            spec.min_replicas = int(config['replicas'])
+        if config.get('ports') is not None:
+            spec.port = int(config['ports'])
+        if config.get('load_balancing_policy') is not None:
+            spec.load_balancing_policy = config['load_balancing_policy']
+            if spec.load_balancing_policy not in ('round_robin',
+                                                  'least_load'):
+                raise exceptions.InvalidTaskError(
+                    f'Unknown load_balancing_policy '
+                    f'{spec.load_balancing_policy!r}')
+        if spec.max_replicas is None:
+            spec.max_replicas = spec.min_replicas
+        if spec.max_replicas < spec.min_replicas:
+            raise exceptions.InvalidTaskError(
+                'max_replicas < min_replicas')
+        if spec.target_qps_per_replica is None and \
+                spec.max_replicas > spec.min_replicas:
+            raise exceptions.InvalidTaskError(
+                'Autoscaling (max>min) requires target_qps_per_replica.')
+        return spec
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {
+            'readiness_probe': {
+                'path': self.readiness_path,
+                'initial_delay_seconds': self.initial_delay_seconds,
+                'timeout_seconds': self.readiness_timeout_seconds,
+            },
+            'replica_policy': {
+                'min_replicas': self.min_replicas,
+                'max_replicas': self.max_replicas,
+                'upscale_delay_seconds': self.upscale_delay_seconds,
+                'downscale_delay_seconds': self.downscale_delay_seconds,
+            },
+            'ports': self.port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        if self.post_data is not None:
+            cfg['readiness_probe']['post_data'] = self.post_data
+        if self.target_qps_per_replica is not None:
+            cfg['replica_policy']['target_qps_per_replica'] = \
+                self.target_qps_per_replica
+        return cfg

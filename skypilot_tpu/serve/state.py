@@ -1,0 +1,121 @@
+"""Serve state DB (reference: sky/serve/serve_state.py)."""
+from __future__ import annotations
+
+import enum
+import json
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import config as config_lib
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'
+    READY = 'READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    PREEMPTED = 'PREEMPTED'
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(str(config_lib.home_dir() / 'serve.db'),
+                           timeout=30)
+    conn.executescript("""
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            status TEXT,
+            controller_pid INTEGER,
+            endpoint TEXT,
+            spec_json TEXT,
+            created_at REAL);
+        CREATE TABLE IF NOT EXISTS replicas (
+            service_name TEXT,
+            replica_id INTEGER,
+            cluster_name TEXT,
+            status TEXT,
+            endpoint TEXT,
+            PRIMARY KEY (service_name, replica_id));
+    """)
+    return conn
+
+
+def add_service(name: str, spec_json: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO services (name, status,'
+            ' controller_pid, endpoint, spec_json, created_at)'
+            ' VALUES (?,?,?,?,?,?)',
+            (name, ServiceStatus.CONTROLLER_INIT.value, None, None,
+             spec_json, time.time()))
+
+
+def set_service(name: str, *, status: Optional[ServiceStatus] = None,
+                controller_pid: Optional[int] = None,
+                endpoint: Optional[str] = None) -> None:
+    with _conn() as conn:
+        if status is not None:
+            conn.execute('UPDATE services SET status=? WHERE name=?',
+                         (status.value, name))
+        if controller_pid is not None:
+            conn.execute('UPDATE services SET controller_pid=? '
+                         'WHERE name=?', (controller_pid, name))
+        if endpoint is not None:
+            conn.execute('UPDATE services SET endpoint=? WHERE name=?',
+                         (endpoint, name))
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    row = _conn().execute(
+        'SELECT name, status, controller_pid, endpoint, spec_json,'
+        ' created_at FROM services WHERE name=?', (name,)).fetchone()
+    if row is None:
+        return None
+    return {'name': row[0], 'status': row[1], 'controller_pid': row[2],
+            'endpoint': row[3], 'spec': json.loads(row[4]),
+            'created_at': row[5]}
+
+
+def get_services() -> List[Dict[str, Any]]:
+    rows = _conn().execute('SELECT name FROM services').fetchall()
+    return [get_service(r[0]) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name=?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service_name=?', (name,))
+
+
+def upsert_replica(service: str, replica_id: int, cluster_name: str,
+                   status: ReplicaStatus,
+                   endpoint: Optional[str]) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO replicas (service_name, replica_id,'
+            ' cluster_name, status, endpoint) VALUES (?,?,?,?,?)',
+            (service, replica_id, cluster_name, status.value, endpoint))
+
+
+def remove_replica(service: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM replicas WHERE service_name=? AND '
+                     'replica_id=?', (service, replica_id))
+
+
+def get_replicas(service: str) -> List[Dict[str, Any]]:
+    rows = _conn().execute(
+        'SELECT replica_id, cluster_name, status, endpoint FROM replicas '
+        'WHERE service_name=? ORDER BY replica_id', (service,)).fetchall()
+    return [{'replica_id': r[0], 'cluster_name': r[1], 'status': r[2],
+             'endpoint': r[3]} for r in rows]

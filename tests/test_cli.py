@@ -1,0 +1,95 @@
+"""CLI tests via CliRunner (reference analog: tests/test_cli.py)."""
+import os
+
+from click.testing import CliRunner
+
+from skypilot_tpu import cli
+
+
+def _invoke(*args):
+    return CliRunner().invoke(cli.cli, list(args),
+                              catch_exceptions=False)
+
+
+def test_show_tpus():
+    r = _invoke('show-tpus')
+    assert r.exit_code == 0
+    assert 'tpu-v5p-64' in r.output and 'SPOT$/HR' in r.output
+
+
+def test_show_tpus_filter():
+    r = _invoke('show-tpus', 'v6e')
+    assert r.exit_code == 0
+    assert 'v6e-8' in r.output and 'v5p' not in r.output
+
+
+def test_check_fake_enabled():
+    r = _invoke('check')
+    assert r.exit_code == 0
+    assert 'fake' in r.output
+
+
+def test_status_empty():
+    r = _invoke('status')
+    assert r.exit_code == 0
+    assert 'NAME' in r.output
+
+
+def test_launch_dryrun_and_status_lifecycle(tmp_path):
+    yaml = tmp_path / 't.yaml'
+    yaml.write_text(
+        'run: echo hi\nresources:\n  accelerators: tpu-v5e-8\n'
+        '  cloud: fake\n')
+    r = _invoke('launch', str(yaml), '-c', 'clicluster', '--dryrun', '-y')
+    assert r.exit_code == 0, r.output
+    assert 'tpu-v5e-8' in r.output
+
+    r = _invoke('launch', str(yaml), '-c', 'clicluster', '-y')
+    assert r.exit_code == 0, r.output
+
+    r = _invoke('status')
+    assert 'clicluster' in r.output and 'UP' in r.output
+
+    r = _invoke('queue', 'clicluster')
+    assert 'SUCCEEDED' in r.output
+
+    r = _invoke('logs', 'clicluster', '1', '--no-follow')
+    # tail exits 0 for no-follow
+    assert r.exit_code == 0
+
+    r = _invoke('autostop', 'clicluster', '-i', '30')
+    assert r.exit_code == 0
+    r = _invoke('status')
+    assert '30m' in r.output
+
+    r = _invoke('down', 'clicluster', '-y')
+    assert r.exit_code == 0
+    r = _invoke('status')
+    assert 'clicluster' not in r.output
+
+
+def test_exec_inline_command(tmp_path):
+    yaml = tmp_path / 't.yaml'
+    yaml.write_text(
+        'run: echo first\nresources:\n  accelerators: tpu-v5e-1\n'
+        '  cloud: fake\n')
+    assert _invoke('launch', str(yaml), '-c', 'ex1', '-y').exit_code == 0
+    r = _invoke('exec', 'ex1', 'echo inline-ran')
+    assert r.exit_code == 0
+    r = _invoke('cancel', 'ex1', '--all')
+    assert r.exit_code == 0
+    assert _invoke('down', 'ex1', '-y').exit_code == 0
+
+
+def test_cost_report_runs():
+    r = _invoke('cost-report')
+    assert r.exit_code == 0
+
+
+def test_launch_resource_override(tmp_path):
+    yaml = tmp_path / 't.yaml'
+    yaml.write_text('run: echo hi\nresources:\n  cloud: fake\n')
+    r = _invoke('launch', str(yaml), '-c', 'ovr', '--dryrun', '-y',
+                '--gpus', 'tpu-v6e-8', '--use-spot')
+    assert r.exit_code == 0
+    assert 'v6e-8' in r.output

@@ -223,3 +223,21 @@ def test_resume_rejects_oversized_task():
     with pytest.raises(exceptions.ResourcesMismatchError):
         sky.launch(_task('true', nodes=2), cluster_name='rsz',
                    quiet_optimizer=True)
+
+
+def test_autostop_daemon_event(monkeypatch):
+    """Autostop event tears down an idle cluster from inside the head
+    (reference: skylet AutostopEvent, events.py:141-266)."""
+    _, handle = sky.launch(_task('true', accel='tpu-v5e-16'),
+                           cluster_name='auto', quiet_optimizer=True)
+    import skypilot_tpu.core as core_mod
+    core_mod.autostop('auto', 0, down_after=True)
+    # Run the daemon's event in the head-host environment.
+    head_dir = (f"{os.environ['SKYT_HOME']}/fake_cloud/clusters/auto/"
+                f"node0-host0")
+    monkeypatch.setenv('HOME', head_dir)
+    from skypilot_tpu.agent import daemon
+    daemon.check_autostop()
+    monkeypatch.delenv('HOME')
+    # Cluster gone at the provider; status refresh notices.
+    assert core.status(['auto'], refresh=True) == []

@@ -1,0 +1,136 @@
+"""SkyServe e2e on the fake cloud: replicas launch as clusters, LB proxies
+and retries, autoscaler scales on QPS, failed replicas get replaced."""
+import json
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.serve import autoscalers, core as serve_core, state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _serve_task(port, min_replicas=1, max_replicas=None, target_qps=None):
+    run = ('python3 -c "\n'
+           'import http.server, os\n'
+           'class H(http.server.BaseHTTPRequestHandler):\n'
+           '    def do_GET(self):\n'
+           '        body = (\'replica-\' + os.environ[\'SKYT_REPLICA_ID\']).encode()\n'
+           '        self.send_response(200)\n'
+           '        self.send_header(\'Content-Length\', str(len(body)))\n'
+           '        self.end_headers()\n'
+           '        self.wfile.write(body)\n'
+           '    def log_message(self, *a): pass\n'
+           'http.server.HTTPServer((\'127.0.0.1\', '
+           'int(os.environ[\'SKYT_REPLICA_PORT\'])), H).serve_forever()\n'
+           '"')
+    t = sky.Task(name='svc', run=run)
+    t.set_resources(sky.Resources.new(accelerators='tpu-v5e-1',
+                                      cloud='fake'))
+    policy = {'min_replicas': min_replicas}
+    if max_replicas:
+        policy['max_replicas'] = max_replicas
+    if target_qps:
+        policy['target_qps_per_replica'] = target_qps
+    policy['upscale_delay_seconds'] = 1
+    policy['downscale_delay_seconds'] = 2
+    t.service = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 20},
+        'replica_policy': policy,
+        'ports': port,
+    })
+    return t
+
+
+def _wait_ready(name, n=1, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        svcs = serve_core.status(name)
+        if svcs:
+            ready = [r for r in svcs[0]['replicas']
+                     if r['status'] == 'READY']
+            if len(ready) >= n:
+                return svcs[0]
+        time.sleep(0.5)
+    raise TimeoutError(f'service {name} not ready: {serve_core.status(name)}')
+
+
+@pytest.fixture
+def fast_tick(monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_TICK_SECONDS', '0.5')
+
+
+def test_serve_up_proxy_down(fast_tick):
+    port = _free_port()
+    name = serve_core.up(_serve_task(port), service_name='s1')
+    svc = _wait_ready(name, 1)
+    assert svc['status'] == 'READY'
+    body = urllib.request.urlopen(
+        f'http://127.0.0.1:{port}/', timeout=10).read().decode()
+    assert body.startswith('replica-')
+    serve_core.down(name)
+    assert serve_core.status(name) == []
+    from skypilot_tpu import global_user_state
+    assert all(not c['name'].startswith('skyt-serve-s1-')
+               for c in global_user_state.get_clusters())
+
+
+def test_serve_replica_replacement(fast_tick):
+    """Killing a replica cluster out-of-band -> probes fail -> replaced."""
+    from skypilot_tpu.provision.fake import instance as fake_cloud
+    port = _free_port()
+    name = serve_core.up(_serve_task(port), service_name='s2')
+    svc = _wait_ready(name, 1)
+    first = svc['replicas'][0]
+    fake_cloud.terminate_instances(first['cluster_name'])
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        svcs = serve_core.status(name)
+        ready = [r for r in svcs[0]['replicas']
+                 if r['status'] == 'READY' and
+                 r['replica_id'] != first['replica_id']]
+        if ready:
+            break
+        time.sleep(0.5)
+    else:
+        raise TimeoutError('replacement replica never became READY')
+    serve_core.down(name)
+
+
+def test_autoscaler_hysteresis_unit():
+    spec = SkyServiceSpec.from_yaml_config({
+        'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                           'target_qps_per_replica': 1,
+                           'upscale_delay_seconds': 2,
+                           'downscale_delay_seconds': 4},
+    })
+    a = autoscalers.RequestRateAutoscaler(spec, tick_seconds=1,
+                                          qps_window_seconds=60)
+    now = time.time()
+    heavy = [now - i * 0.5 for i in range(120)]   # 2 qps
+    assert a.evaluate(heavy).target_num_replicas == 1   # tick 1: no change
+    assert a.evaluate(heavy).target_num_replicas == 2   # tick 2: upscale
+    # downscale needs 4 quiet ticks
+    for _ in range(3):
+        assert a.evaluate([]).target_num_replicas == 2
+    assert a.evaluate([]).target_num_replicas == 1
+
+
+def test_service_spec_validation():
+    import pytest as _pytest
+    from skypilot_tpu import exceptions
+    with _pytest.raises(exceptions.InvalidTaskError):
+        SkyServiceSpec.from_yaml_config({
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 3}})
+    spec = SkyServiceSpec.from_yaml_config({'replicas': 2})
+    assert spec.min_replicas == spec.max_replicas == 2

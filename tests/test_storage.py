@@ -1,0 +1,60 @@
+"""Storage tests: LocalStore lifecycle + spec parsing + mount cmd
+builders (reference analog: storage parts of tests/unit_tests)."""
+import os
+
+import pytest
+
+from skypilot_tpu import exceptions, global_user_state
+from skypilot_tpu.data import mounting_utils, storage
+
+
+def test_local_store_lifecycle(tmp_path):
+    src = tmp_path / 'data'
+    src.mkdir()
+    (src / 'x.txt').write_text('hello')
+    s = storage.Storage(name='bkt', source=str(src),
+                        store_type=storage.StoreType.LOCAL)
+    store = s.create_and_upload()
+    assert store.exists()
+    assert [r['name'] for r in global_user_state.get_storage()] == ['bkt']
+    # sync-down command materializes content
+    dst = tmp_path / 'restore'
+    os.system(store.sync_down_cmd(str(dst)))
+    assert (dst / 'x.txt').read_text() == 'hello'
+    storage.delete_storage('bkt')
+    assert not store.exists()
+    assert global_user_state.get_storage() == []
+
+
+def test_storage_yaml_forms():
+    s = storage.Storage.from_yaml_config('/data', {
+        'name': 'mybkt', 'store': 'gcs', 'mode': 'COPY'})
+    assert s.store_type == storage.StoreType.GCS
+    assert s.mode == storage.StorageMode.COPY
+    with pytest.raises(ValueError):
+        storage.Storage.from_yaml_config('/d', {'store': 's3'})
+
+
+def test_missing_source_raises(tmp_path):
+    s = storage.Storage(name='b2', source=str(tmp_path / 'nope'),
+                        store_type=storage.StoreType.LOCAL)
+    with pytest.raises(exceptions.StorageSpecError):
+        s.create_and_upload()
+
+
+def test_gcsfuse_cmd():
+    cmd = mounting_utils.get_gcsfuse_mount_cmd('bkt', '/data')
+    assert 'gcsfuse' in cmd and '--implicit-dirs' in cmd and '/data' in cmd
+    assert 'mountpoint -q' in mounting_utils.get_mount_check_cmd('/data')
+
+
+def test_single_file_source(tmp_path):
+    f = tmp_path / 'one.csv'
+    f.write_text('a,b')
+    s = storage.Storage(name='filebkt', source=str(f),
+                        store_type=storage.StoreType.LOCAL)
+    store = s.create_and_upload()
+    dst = tmp_path / 'out'
+    os.system(store.sync_down_cmd(str(dst)))
+    assert (dst / 'one.csv').read_text() == 'a,b'
+    storage.delete_storage('filebkt')
