@@ -42,6 +42,11 @@ class ClusterHandle:
     launched_nodes: int
     launched_resources: resources_lib.Resources
     cluster_info: provision_common.ClusterInfo
+    # Provider bookkeeping from bootstrap_config (project id, zone, node
+    # count, TPU-vs-GCE) — required by every post-launch provider call
+    # (stop/terminate/query). The reference persists this inside the
+    # generated cluster YAML (backend_utils.py:691); we keep it typed.
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def num_hosts_per_node(self) -> int:
@@ -126,7 +131,8 @@ class CloudTpuBackend:
             cluster_name=cluster_name, cloud=res.cloud,
             launched_nodes=num_nodes,
             launched_resources=result.resources,
-            cluster_info=result.cluster_info)
+            cluster_info=result.cluster_info,
+            provider_config=result.provider_config)
         global_user_state.add_or_update_cluster(
             cluster_name, handle, global_user_state.ClusterStatus.INIT,
             is_launch=True)
@@ -328,10 +334,12 @@ class CloudTpuBackend:
             raise exceptions.NotSupportedError(
                 'TPU pod slices cannot be stopped (no per-host disks to '
                 'preserve); use down instead.')
-        provision.stop_instances(handle.cloud, handle.cluster_name)
+        provision.stop_instances(handle.cloud, handle.cluster_name,
+                                 getattr(handle, 'provider_config', {}))
         global_user_state.set_cluster_status(
             handle.cluster_name, global_user_state.ClusterStatus.STOPPED)
 
     def teardown(self, handle: ClusterHandle) -> None:
-        provision.terminate_instances(handle.cloud, handle.cluster_name)
+        provision.terminate_instances(handle.cloud, handle.cluster_name,
+                                      getattr(handle, 'provider_config', {}))
         global_user_state.remove_cluster(handle.cluster_name)

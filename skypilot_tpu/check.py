@@ -16,12 +16,13 @@ _ENABLED_CLOUDS_KEY = 'enabled_clouds'
 
 
 def _check_gcp() -> Tuple[bool, Optional[str]]:
-    """GCP is enabled iff application-default credentials + project exist."""
+    """GCP is enabled iff an access token + project are resolvable through
+    the provider's credential chain (env token / gcloud / metadata server —
+    provision/gcp/client.py)."""
+    from skypilot_tpu.provision.gcp import client as gcp_client
     try:
-        import google.auth  # type: ignore
-        creds, project = google.auth.default()
-        if project is None:
-            return False, 'No default GCP project set.'
+        gcp_client.get_access_token()
+        gcp_client.get_project_id()
         return True, None
     except Exception as e:  # pylint: disable=broad-except
         return False, f'GCP credentials not found: {e}'

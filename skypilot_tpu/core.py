@@ -36,7 +36,8 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
         return record
     name = record['name']
     try:
-        statuses = provision.query_instances(handle.cloud, name)
+        statuses = provision.query_instances(handle.cloud, name,
+                                             getattr(handle, 'provider_config', {}))
     except Exception as e:  # noqa: BLE001 — cloud probe failed; keep as-is
         logger.debug(f'status refresh failed for {name}: {e}')
         return record
@@ -84,6 +85,7 @@ def start(cluster_name: str) -> None:
         cluster_name=cluster_name, cloud=handle.cloud, resources=res,
         num_nodes=handle.launched_nodes, candidates=offerings)
     handle.cluster_info = result.cluster_info
+    handle.provider_config = result.provider_config
     global_user_state.add_or_update_cluster(
         cluster_name, handle, global_user_state.ClusterStatus.INIT,
         is_launch=True)

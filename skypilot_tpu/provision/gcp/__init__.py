@@ -1,0 +1,9 @@
+"""GCP provider: TPU-VM pod slices (tpu.googleapis.com v2) + GCE VMs.
+
+Reference equivalent: sky/provision/gcp/ (3720 LoC — instance.py,
+config.py, instance_utils.py). Re-designed TPU-first: the TPU node is the
+primary resource (GCE VMs exist only for controllers/CPU tasks), the REST
+surface is a thin hand-rolled client (no googleapiclient discovery), and
+capacity/quota failures surface as typed exceptions instead of stdout
+scraping (FailoverCloudErrorHandlerV2, cloud_vm_ray_backend.py:968-1123).
+"""

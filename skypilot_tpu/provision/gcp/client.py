@@ -1,0 +1,235 @@
+"""Minimal GCP REST client with pluggable transport + credentials.
+
+The reference leans on googleapiclient discovery documents
+(sky/adaptors/gcp.py, sky/provision/gcp/config.py:99-105). We talk REST
+directly with urllib: fewer moving parts, no SDK dependency, and the
+transport is injectable so the whole provider is unit-testable offline
+(SURVEY.md §4 notes the reference cannot test its providers without live
+clouds).
+
+Credential chain (first hit wins):
+  1. injected token via `set_token_provider` (tests),
+  2. `GOOGLE_OAUTH_ACCESS_TOKEN` env var,
+  3. `gcloud auth print-access-token`,
+  4. GCE/TPU-VM metadata server (when running on a controller VM).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_METADATA_TOKEN_URL = ('http://metadata.google.internal/computeMetadata/v1/'
+                       'instance/service-accounts/default/token')
+_METADATA_PROJECT_URL = ('http://metadata.google.internal/computeMetadata/'
+                         'v1/project/project-id')
+
+
+class GcpApiError(Exception):
+    """HTTP-level failure from a GCP API, with parsed error body."""
+
+    def __init__(self, status: int, reason: str, message: str,
+                 body: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(f'GCP API error {status} ({reason}): {message}')
+        self.status = status
+        self.reason = reason
+        self.message = message
+        self.body = body or {}
+
+
+def classify_api_error(err: GcpApiError, zone: str) -> exceptions.ProvisionError:
+    """Map a GCP API failure to a typed failover error.
+
+    Behavioral spec: FailoverCloudErrorHandlerV2._gcp_handler
+    (cloud_vm_ray_backend.py:968-1123) — stockouts blocklist the zone,
+    quota problems the region, auth/config problems the cloud. Quota is
+    checked before capacity: a RESOURCE_EXHAUSTED quota message must
+    blocklist the region, not one zone.
+    """
+    msg = err.message.lower()
+    where = f' (zone {zone})' if zone else ''
+    if 'quota' in msg:
+        return exceptions.QuotaExceededError(err.message + where)
+    if err.status == 429 or 'no more capacity' in msg or 'stockout' in msg or (
+            'resource_exhausted' in msg or 'out of capacity' in msg or
+            'not enough resources' in msg):
+        return exceptions.TpuCapacityError(err.message + where)
+    if err.status in (401, 403):
+        return exceptions.ProvisionError(
+            err.message, scope=exceptions.FailoverScope.CLOUD,
+            retryable=False)
+    if err.status == 409:  # already exists / concurrent op
+        return exceptions.ProvisionError(err.message + where, retryable=True)
+    return exceptions.ProvisionError(err.message + where)
+
+
+# LRO errors carry google.rpc.Status canonical codes, not HTTP statuses;
+# translate before classification so the 429/403 branches fire.
+_GRPC_TO_HTTP = {3: 400, 5: 404, 6: 409, 7: 403, 8: 429, 9: 400,
+                 13: 500, 14: 503, 16: 401}
+
+
+def grpc_code_to_http(code: int) -> int:
+    if code >= 100:  # already an HTTP status
+        return code
+    return _GRPC_TO_HTTP.get(code, 500)
+
+
+# --------------------------------------------------------------------- #
+# Transport + token injection (tests swap these out)
+# --------------------------------------------------------------------- #
+
+# transport(method, url, headers, body_bytes|None, timeout) -> (status, body)
+Transport = Callable[[str, str, Dict[str, str], Optional[bytes], float],
+                     'tuple[int, bytes]']
+
+_transport: Optional[Transport] = None
+_token_provider: Optional[Callable[[], str]] = None
+
+
+def set_transport(transport: Optional[Transport]) -> None:
+    global _transport
+    _transport = transport
+
+
+def set_token_provider(provider: Optional[Callable[[], str]]) -> None:
+    global _token_provider
+    _token_provider = provider
+
+
+def _urllib_transport(method: str, url: str, headers: Dict[str, str],
+                      body: Optional[bytes], timeout: float):
+    req = urllib.request.Request(url, data=body, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# --------------------------------------------------------------------- #
+# Credentials
+# --------------------------------------------------------------------- #
+
+def _maybe_on_gce() -> bool:
+    """Cheap local check before probing the metadata server: off-GCE the
+    DNS lookup for metadata.google.internal can blackhole for seconds."""
+    return (os.path.exists('/sys/class/dmi/id/product_name') and
+            'Google' in pathlib_read('/sys/class/dmi/id/product_name'))
+
+
+def pathlib_read(path: str) -> str:
+    try:
+        with open(path, encoding='utf-8', errors='replace') as f:
+            return f.read()
+    except OSError:
+        return ''
+
+
+_cached_token: Optional[str] = None
+_cached_token_time: float = 0.0
+_TOKEN_TTL_S = 600.0
+
+
+def get_access_token() -> str:
+    global _cached_token, _cached_token_time
+    if _token_provider is not None:
+        return _token_provider()
+    if _cached_token and time.time() - _cached_token_time < _TOKEN_TTL_S:
+        return _cached_token
+    token = os.environ.get('GOOGLE_OAUTH_ACCESS_TOKEN')
+    if not token and shutil.which('gcloud'):
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'print-access-token'],
+                capture_output=True, timeout=15, check=False)
+            if proc.returncode == 0:
+                token = proc.stdout.decode().strip()
+        except subprocess.TimeoutExpired:
+            token = None
+    if not token and _maybe_on_gce():
+        try:
+            status, body = _urllib_transport(
+                'GET', _METADATA_TOKEN_URL,
+                {'Metadata-Flavor': 'Google'}, None, 2.0)
+            if status == 200:
+                token = json.loads(body)['access_token']
+        except OSError:
+            token = None
+    if not token:
+        raise exceptions.NoCloudAccessError(
+            'No GCP credentials found. Set GOOGLE_OAUTH_ACCESS_TOKEN, '
+            'install gcloud, or run on a GCE/TPU VM.')
+    _cached_token, _cached_token_time = token, time.time()
+    return token
+
+
+def get_project_id(provider_config: Optional[Dict[str, Any]] = None) -> str:
+    if provider_config and provider_config.get('project_id'):
+        return provider_config['project_id']
+    env = os.environ.get('GOOGLE_CLOUD_PROJECT') or os.environ.get(
+        'GCP_PROJECT')
+    if env:
+        return env
+    if shutil.which('gcloud'):
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'config', 'get-value', 'project'],
+                capture_output=True, timeout=15, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return proc.stdout.decode().strip()
+        except subprocess.TimeoutExpired:
+            pass
+    if _maybe_on_gce():
+        try:
+            status, body = _urllib_transport(
+                'GET', _METADATA_PROJECT_URL,
+                {'Metadata-Flavor': 'Google'}, None, 2.0)
+            if status == 200:
+                return body.decode().strip()
+        except OSError:
+            pass
+    raise exceptions.NoCloudAccessError(
+        'Could not determine GCP project id; set GOOGLE_CLOUD_PROJECT or '
+        'pass provider_config.project_id.')
+
+
+# --------------------------------------------------------------------- #
+# Request
+# --------------------------------------------------------------------- #
+
+def request(method: str, url: str, body: Optional[Dict[str, Any]] = None,
+            timeout: float = 60.0) -> Dict[str, Any]:
+    """One authenticated JSON request. Raises GcpApiError on HTTP errors."""
+    transport = _transport or _urllib_transport
+    headers = {
+        'Authorization': f'Bearer {get_access_token()}',
+        'Content-Type': 'application/json',
+    }
+    data = json.dumps(body).encode() if body is not None else None
+    status, raw = transport(method, url, headers, data, timeout)
+    parsed: Dict[str, Any] = {}
+    if raw:
+        try:
+            parsed = json.loads(raw)
+        except json.JSONDecodeError:
+            parsed = {'raw': raw.decode(errors='replace')}
+    if status >= 400:
+        err = parsed.get('error', {}) if isinstance(parsed, dict) else {}
+        raise GcpApiError(
+            status=status,
+            reason=err.get('status', str(status)),
+            message=err.get('message', str(parsed)[:500]),
+            body=parsed)
+    return parsed

@@ -1,0 +1,148 @@
+"""GCE compute REST wrapper — controller / CPU-task VMs + firewall ports.
+
+Reference equivalent: GCPComputeInstance (gcp/instance_utils.py:311-977).
+Only the subset the TPU-first framework needs: instances for jobs/serve
+controllers and CPU tasks, firewall rules for `ports:`.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import client
+
+logger = sky_logging.init_logger(__name__)
+
+_BASE = 'https://compute.googleapis.com/compute/v1'
+
+
+def _zone_url(project: str, zone: str) -> str:
+    return f'{_BASE}/projects/{project}/zones/{zone}'
+
+
+def instance_body(project: str, zone: str, name: str, machine_type: str,
+                  ssh_user: str, ssh_public_key: str,
+                  labels: Dict[str, str],
+                  disk_size_gb: int = 256,
+                  image: str = ('projects/ubuntu-os-cloud/global/images/'
+                                'family/ubuntu-2204-lts'),
+                  use_spot: bool = False,
+                  network: str = 'global/networks/default',
+                  tags: Optional[List[str]] = None) -> Dict[str, Any]:
+    body: Dict[str, Any] = {
+        'name': name,
+        'machineType': f'zones/{zone}/machineTypes/{machine_type}',
+        'disks': [{
+            'boot': True,
+            'autoDelete': True,
+            'initializeParams': {
+                'sourceImage': image,
+                'diskSizeGb': str(disk_size_gb),
+            },
+        }],
+        'networkInterfaces': [{
+            'network': network,
+            'accessConfigs': [{'name': 'External NAT',
+                               'type': 'ONE_TO_ONE_NAT'}],
+        }],
+        'metadata': {
+            'items': [{'key': 'ssh-keys',
+                       'value': f'{ssh_user}:{ssh_public_key}'}],
+        },
+        'labels': dict(labels),
+        'tags': {'items': tags or ['skypilot-tpu']},
+    }
+    if use_spot:
+        body['scheduling'] = {
+            'provisioningModel': 'SPOT',
+            'instanceTerminationAction': 'STOP',
+        }
+    return body
+
+
+def insert_instance(project: str, zone: str,
+                    body: Dict[str, Any]) -> Dict[str, Any]:
+    return client.request('POST', f'{_zone_url(project, zone)}/instances',
+                          body)
+
+
+def get_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return client.request(
+        'GET', f'{_zone_url(project, zone)}/instances/{name}')
+
+
+def delete_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return client.request(
+        'DELETE', f'{_zone_url(project, zone)}/instances/{name}')
+
+
+def stop_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return client.request(
+        'POST', f'{_zone_url(project, zone)}/instances/{name}/stop', {})
+
+
+def start_instance(project: str, zone: str, name: str) -> Dict[str, Any]:
+    return client.request(
+        'POST', f'{_zone_url(project, zone)}/instances/{name}/start', {})
+
+
+def wait_zone_operation(project: str, zone: str, op: Dict[str, Any],
+                        timeout_s: float = 600.0,
+                        poll_s: float = 3.0) -> Dict[str, Any]:
+    name = op.get('name', '')
+    deadline = time.time() + timeout_s
+    url = f'{_zone_url(project, zone)}/operations/{name}'
+    while True:
+        if op.get('status') == 'DONE':
+            break
+        if time.time() > deadline:
+            raise TimeoutError(f'GCE operation {name} timed out')
+        time.sleep(poll_s)
+        op = client.request('GET', url)
+    err = op.get('error', {}).get('errors', [])
+    if err:
+        first = err[0]
+        api_err = client.GcpApiError(
+            status=409 if 'EXISTS' in first.get('code', '') else 500,
+            reason=first.get('code', ''),
+            message=first.get('message', str(first)))
+        raise client.classify_api_error(api_err, zone)
+    return op
+
+
+# --------------------------------------------------------------------- #
+# Firewall (open_ports / cleanup_ports)
+# --------------------------------------------------------------------- #
+
+def _firewall_name(cluster_name: str) -> str:
+    return f'skyt-{cluster_name}-ports'
+
+
+def open_ports(project: str, cluster_name: str, ports: List[int],
+               network: str = 'global/networks/default') -> None:
+    body = {
+        'name': _firewall_name(cluster_name),
+        'network': network,
+        'direction': 'INGRESS',
+        'allowed': [{'IPProtocol': 'tcp',
+                     'ports': [str(p) for p in ports]}],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': ['skypilot-tpu'],
+    }
+    try:
+        client.request(
+            'POST', f'{_BASE}/projects/{project}/global/firewalls', body)
+    except client.GcpApiError as e:
+        if e.status != 409:  # already exists is fine
+            raise
+
+
+def cleanup_ports(project: str, cluster_name: str) -> None:
+    try:
+        client.request(
+            'DELETE', f'{_BASE}/projects/{project}/global/firewalls/'
+            f'{_firewall_name(cluster_name)}')
+    except client.GcpApiError as e:
+        if e.status != 404:
+            raise

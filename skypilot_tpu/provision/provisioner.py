@@ -39,6 +39,9 @@ class ProvisionResult:
     record: common.ProvisionRecord
     cluster_info: common.ClusterInfo
     resources: resources_lib.Resources   # concrete, zone-pinned
+    # Provider bookkeeping filled by bootstrap_config; must accompany every
+    # later provider call (stop/terminate/query/get_cluster_info).
+    provider_config: Dict = dataclasses.field(default_factory=dict)
 
 
 @timeline.event
@@ -76,16 +79,19 @@ def provision_with_failover(
             record = provision.run_instances(cloud, config)
             provision.wait_instances(cloud, region, cluster_name,
                                      common.InstanceStatus.RUNNING)
-            info = provision.get_cluster_info(cloud, region, cluster_name)
+            info = provision.get_cluster_info(cloud, region, cluster_name,
+                                              config.provider_config)
             concrete = resources.copy(cloud=cloud, region=region, zone=zone)
             return ProvisionResult(record=record, cluster_info=info,
-                                   resources=concrete)
+                                   resources=concrete,
+                                   provider_config=config.provider_config)
         except exceptions.ProvisionError as e:
             failures.append(e)
             logger.warning(f'  {zone}: {e}')
             # Clean partial state before moving on.
             try:
-                provision.terminate_instances(cloud, cluster_name)
+                provision.terminate_instances(cloud, cluster_name,
+                                              config.provider_config)
             except Exception:  # noqa: BLE001
                 pass
             if e.scope == exceptions.FailoverScope.ZONE:

@@ -1,0 +1,340 @@
+"""GCP provider tests against an in-memory fake of the REST APIs.
+
+The reference cannot test its GCP provisioner without live credentials
+(SURVEY.md §4 — smoke tests only); here the whole provider protocol runs
+against a FakeGcpService transport: node lifecycle, multi-host
+networkEndpoints fan-out, stockout→TpuCapacityError failover mapping,
+queued resources, and GCE controller VMs.
+"""
+import json
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import tpu_topology
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gcp import client
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+
+
+class FakeGcpService:
+    """In-memory TPU v2 + GCE v1 REST service."""
+
+    def __init__(self, stockout_zones=(), quota_fail=False,
+                 hosts_per_node=1):
+        self.tpu_nodes = {}       # (zone, name) -> node dict
+        self.gce = {}             # (zone, name) -> instance dict
+        self.queued = {}          # (zone, name) -> qr dict
+        self.stockout_zones = set(stockout_zones)
+        self.quota_fail = quota_fail
+        self.hosts_per_node = hosts_per_node
+        self.requests = []
+
+    # -- transport ----------------------------------------------------- #
+    def __call__(self, method, url, headers, body, timeout):
+        self.requests.append((method, url))
+        data = json.loads(body) if body else {}
+        status, resp = self.route(method, url, data)
+        return status, json.dumps(resp).encode()
+
+    def _err(self, status, reason, message):
+        return status, {'error': {'status': reason, 'message': message}}
+
+    def route(self, method, url, data):
+        m = re.match(
+            r'https://tpu\.googleapis\.com/v2/projects/(?P<p>[^/]+)/'
+            r'locations/(?P<z>[^/]+)/(?P<rest>.*)', url)
+        if m:
+            return self.route_tpu(method, m['z'], m['rest'], data)
+        m = re.match(
+            r'https://compute\.googleapis\.com/compute/v1/projects/'
+            r'(?P<p>[^/]+)/(?P<rest>.*)', url)
+        if m:
+            return self.route_gce(method, m['rest'], data)
+        return self._err(404, 'NOT_FOUND', f'no route {url}')
+
+    # -- TPU API ------------------------------------------------------- #
+    def _make_node(self, zone, name, data, state='READY'):
+        eps = [{'ipAddress': f'10.0.{len(self.tpu_nodes)}.{i + 2}',
+                'accessConfig': {'externalIp': f'34.1.{len(self.tpu_nodes)}.{i + 2}'}}
+               for i in range(self.hosts_per_node)]
+        node = dict(data)
+        node.update({'name': name, 'state': state,
+                     'networkEndpoints': eps})
+        self.tpu_nodes[(zone, name)] = node
+        return node
+
+    def route_tpu(self, method, zone, rest, data):
+        if rest.startswith('nodes'):
+            if method == 'POST' and '?nodeId=' in rest:
+                name = rest.split('?nodeId=')[1]
+                if self.quota_fail:
+                    return self._err(
+                        403, 'PERMISSION_DENIED',
+                        'Quota limit TPUV5sPodPerProjectPerZone exceeded')
+                if zone in self.stockout_zones:
+                    return self._err(
+                        429, 'RESOURCE_EXHAUSTED',
+                        f'There is no more capacity in the zone "{zone}"')
+                self._make_node(zone, name, data)
+                return 200, {'name': f'projects/p/locations/{zone}/'
+                                     f'operations/op-{name}', 'done': True}
+            name = rest.split('/', 1)[1].split(':')[0] if '/' in rest else ''
+            node = self.tpu_nodes.get((zone, name))
+            if method == 'GET':
+                if node is None:
+                    return self._err(404, 'NOT_FOUND', f'{name} not found')
+                return 200, node
+            if method == 'DELETE':
+                if node is None:
+                    return self._err(404, 'NOT_FOUND', f'{name} not found')
+                del self.tpu_nodes[(zone, name)]
+                return 200, {'done': True}
+            if method == 'POST' and rest.endswith(':stop'):
+                node['state'] = 'STOPPED'
+                return 200, {'done': True}
+            if method == 'POST' and rest.endswith(':start'):
+                node['state'] = 'READY'
+                return 200, {'done': True}
+        if rest.startswith('queuedResources'):
+            if method == 'POST':
+                qr_id = rest.split('?queuedResourceId=')[1]
+                if self.quota_fail:
+                    return self._err(
+                        403, 'PERMISSION_DENIED',
+                        'Quota limit TPUV5sPodPerProjectPerZone exceeded')
+                if zone in self.stockout_zones:
+                    self.queued[(zone, qr_id)] = {
+                        'state': {'state': 'FAILED',
+                                  'stateInitiator': 'stockout'}}
+                else:
+                    spec = data['tpu']['nodeSpec'][0]
+                    self._make_node(zone, spec['nodeId'], spec['node'])
+                    self.queued[(zone, qr_id)] = {
+                        'state': {'state': 'ACTIVE'}}
+                return 200, {'done': True}
+            qr_id = rest.split('/', 1)[1].split('?')[0]
+            qr = self.queued.get((zone, qr_id))
+            if method == 'GET':
+                if qr is None:
+                    return self._err(404, 'NOT_FOUND', qr_id)
+                return 200, qr
+            if method == 'DELETE':
+                self.queued.pop((zone, qr_id), None)
+                return 200, {'done': True}
+        if rest.startswith('operations'):
+            return 200, {'done': True}
+        return self._err(404, 'NOT_FOUND', rest)
+
+    # -- GCE API ------------------------------------------------------- #
+    def route_gce(self, method, rest, data):
+        m = re.match(r'zones/(?P<z>[^/]+)/(?P<rest>.*)', rest)
+        if m:
+            zone, rest = m['z'], m['rest']
+            if rest == 'instances' and method == 'POST':
+                if zone in self.stockout_zones:
+                    return self._err(
+                        429, 'RESOURCE_EXHAUSTED',
+                        'The zone does not have enough resources')
+                name = data['name']
+                self.gce[(zone, name)] = {
+                    'name': name, 'status': 'RUNNING',
+                    'networkInterfaces': [{
+                        'networkIP': f'10.1.0.{len(self.gce) + 2}',
+                        'accessConfigs': [
+                            {'natIP': f'34.2.0.{len(self.gce) + 2}'}],
+                    }]}
+                return 200, {'name': f'op-{name}', 'status': 'DONE'}
+            if rest.startswith('instances/'):
+                name = rest.split('/')[1]
+                inst = self.gce.get((zone, name))
+                if method == 'GET':
+                    if inst is None:
+                        return self._err(404, 'NOT_FOUND', name)
+                    return 200, inst
+                if method == 'DELETE':
+                    if inst is None:
+                        return self._err(404, 'NOT_FOUND', name)
+                    del self.gce[(zone, name)]
+                    return 200, {'status': 'DONE'}
+                if rest.endswith('/stop'):
+                    inst['status'] = 'TERMINATED'
+                    return 200, {'status': 'DONE'}
+                if rest.endswith('/start'):
+                    inst['status'] = 'RUNNING'
+                    return 200, {'status': 'DONE'}
+            if rest.startswith('operations/'):
+                return 200, {'status': 'DONE'}
+        if rest.startswith('global/firewalls'):
+            return 200, {'status': 'DONE'}
+        return self._err(404, 'NOT_FOUND', rest)
+
+
+@pytest.fixture
+def fake_gcp():
+    def install(**kwargs):
+        svc = FakeGcpService(**kwargs)
+        client.set_transport(svc)
+        client.set_token_provider(lambda: 'fake-token')
+        return svc
+    yield install
+    client.set_transport(None)
+    client.set_token_provider(None)
+
+
+def _tpu_config(tpu='v5p-16', zone='us-east5-a', num_nodes=1, **res_kw):
+    res = resources_lib.Resources(
+        cloud='gcp', tpu=tpu_topology.parse_tpu_type(tpu),
+        zone=zone, **res_kw)
+    cfg = common.ProvisionConfig(
+        cluster_name='mycluster', cloud='gcp', region=zone.rsplit('-', 1)[0],
+        zone=zone, num_nodes=num_nodes, resources=res,
+        authentication={'ssh_user': 'skyt', 'ssh_public_key': 'ssh-rsa AAA',
+                        'ssh_private_key': '/tmp/k'},
+        provider_config={'project_id': 'proj'})
+    return gcp_instance.bootstrap_config(cfg)
+
+
+def test_tpu_create_and_cluster_info_multihost(fake_gcp):
+    # v5p-16 = 8 chips over 2 hosts -> 2 InstanceInfos from one node.
+    svc = fake_gcp(hosts_per_node=2)
+    cfg = _tpu_config('v5p-16')
+    rec = gcp_instance.run_instances(cfg)
+    assert rec.created_instance_ids == ['mycluster-0']
+    info = gcp_instance.get_cluster_info(
+        cfg.region, cfg.cluster_name, cfg.provider_config)
+    assert info.num_hosts == 2
+    ranks = [(i.node_index, i.host_index) for i in info.sorted_instances()]
+    assert ranks == [(0, 0), (0, 1)]
+    assert all(i.runner_spec['kind'] == 'ssh' for i in info.instances)
+    assert info.instances[0].external_ip.startswith('34.')
+
+
+def test_tpu_stockout_maps_to_capacity_error(fake_gcp):
+    fake_gcp(stockout_zones={'us-east5-a'})
+    cfg = _tpu_config('v5p-16')
+    with pytest.raises(exceptions.TpuCapacityError):
+        gcp_instance.run_instances(cfg)
+
+
+def test_quota_error_maps_to_region_scope(fake_gcp):
+    fake_gcp(quota_fail=True)
+    cfg = _tpu_config('v5p-16')
+    with pytest.raises(exceptions.QuotaExceededError) as ei:
+        gcp_instance.run_instances(cfg)
+    assert ei.value.scope == exceptions.FailoverScope.REGION
+
+
+def test_queued_resources_pod_path(fake_gcp):
+    svc = fake_gcp(hosts_per_node=4)
+    cfg = _tpu_config('v5p-32')   # pod -> queued resources by default
+    assert cfg.provider_config['use_queued_resources']
+    gcp_instance.run_instances(cfg)
+    assert any('queuedResources' in u for _, u in svc.requests)
+    info = gcp_instance.get_cluster_info(
+        cfg.region, cfg.cluster_name, cfg.provider_config)
+    assert info.num_hosts == 4
+
+
+def test_queued_resource_stockout(fake_gcp):
+    fake_gcp(stockout_zones={'us-east5-a'})
+    cfg = _tpu_config('v5p-32')
+    with pytest.raises(exceptions.TpuCapacityError):
+        gcp_instance.run_instances(cfg)
+
+
+def test_tpu_stop_start_cycle_single_host(fake_gcp):
+    svc = fake_gcp(hosts_per_node=1)
+    cfg = _tpu_config('v5e-8')
+    gcp_instance.run_instances(cfg)
+    gcp_instance.stop_instances('mycluster', cfg.provider_config)
+    st = gcp_instance.query_instances('mycluster', cfg.provider_config)
+    assert st == {'mycluster-0': common.InstanceStatus.STOPPED}
+    rec = gcp_instance.run_instances(cfg)   # resume
+    assert rec.resumed_instance_ids == ['mycluster-0']
+    st = gcp_instance.query_instances('mycluster', cfg.provider_config)
+    assert st == {'mycluster-0': common.InstanceStatus.RUNNING}
+
+
+def test_tpu_pod_stop_refused(fake_gcp):
+    fake_gcp(hosts_per_node=2)
+    cfg = _tpu_config('v5p-16')
+    gcp_instance.run_instances(cfg)
+    with pytest.raises(exceptions.NotSupportedError):
+        gcp_instance.stop_instances('mycluster', cfg.provider_config)
+
+
+def test_terminate_removes_everything(fake_gcp):
+    svc = fake_gcp(hosts_per_node=2)
+    cfg = _tpu_config('v5p-16')
+    gcp_instance.run_instances(cfg)
+    gcp_instance.terminate_instances('mycluster', cfg.provider_config)
+    assert not svc.tpu_nodes
+    assert gcp_instance.query_instances(
+        'mycluster', cfg.provider_config) == {}
+
+
+def test_gce_controller_vm_lifecycle(fake_gcp):
+    svc = fake_gcp()
+    res = resources_lib.Resources(cloud='gcp', instance_type='n2-standard-8',
+                                  zone='us-central1-a')
+    cfg = common.ProvisionConfig(
+        cluster_name='ctrl', cloud='gcp', region='us-central1',
+        zone='us-central1-a', num_nodes=1, resources=res,
+        authentication={'ssh_user': 'skyt', 'ssh_public_key': 'k',
+                        'ssh_private_key': '/tmp/k'},
+        provider_config={'project_id': 'proj'})
+    cfg = gcp_instance.bootstrap_config(cfg)
+    rec = gcp_instance.run_instances(cfg)
+    assert rec.created_instance_ids == ['ctrl-0']
+    info = gcp_instance.get_cluster_info(
+        'us-central1', 'ctrl', cfg.provider_config)
+    assert info.num_hosts == 1
+    assert info.head_instance.external_ip.startswith('34.')
+    gcp_instance.stop_instances('ctrl', cfg.provider_config)
+    assert gcp_instance.query_instances('ctrl', cfg.provider_config) == {
+        'ctrl-0': common.InstanceStatus.STOPPED}
+    gcp_instance.terminate_instances('ctrl', cfg.provider_config)
+    assert not svc.gce
+
+
+def test_multi_node_tpu_cluster(fake_gcp):
+    # num_nodes=2 slices (multislice DCN setup): 2 TPU nodes created.
+    svc = fake_gcp(hosts_per_node=2)
+    cfg = _tpu_config('v5p-16', num_nodes=2)
+    rec = gcp_instance.run_instances(cfg)
+    assert rec.created_instance_ids == ['mycluster-0', 'mycluster-1']
+    info = gcp_instance.get_cluster_info(
+        cfg.region, cfg.cluster_name, cfg.provider_config)
+    assert info.num_hosts == 4
+    ranks = [(i.node_index, i.host_index) for i in info.sorted_instances()]
+    assert ranks == [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+def test_failover_loop_with_gcp_provider(fake_gcp, monkeypatch, tmp_path):
+    """provision_with_failover drives the real GCP provider: first zone is
+    stocked out -> typed error -> blocklist -> next zone succeeds, and
+    provider_config is threaded into the returned result (the contract
+    every later stop/terminate/query call depends on)."""
+    from skypilot_tpu.provision import provisioner
+
+    monkeypatch.setenv('SKYT_HOME', str(tmp_path))
+    monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'proj')
+    res = resources_lib.Resources(cloud='gcp',
+                                  tpu=tpu_topology.parse_tpu_type('v5e-8'))
+    candidates = res.get_offerings()
+    assert len(candidates) > 1
+    svc = fake_gcp(stockout_zones={candidates[0].zone})
+    result = provisioner.provision_with_failover(
+        cluster_name='fo', cloud='gcp', resources=res,
+        num_nodes=1, candidates=candidates)
+    assert result.resources.zone == candidates[1].zone
+    assert result.provider_config['project_id'] == 'proj'
+    assert result.provider_config['is_tpu']
+    # post-launch verbs work off the threaded provider_config
+    st = gcp_instance.query_instances('fo', result.provider_config)
+    assert list(st.values()) == [common.InstanceStatus.RUNNING]
+    gcp_instance.terminate_instances('fo', result.provider_config)
+    assert not svc.tpu_nodes
