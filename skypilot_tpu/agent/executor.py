@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from skypilot_tpu.agent import constants
 from skypilot_tpu.agent import job_lib
+from skypilot_tpu.agent import native
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner
 
@@ -109,14 +110,17 @@ class GangExecutor:
         return f'~/.skyt_agent/jobs/{self.job_id}/{phase}-rank{rank}.pid'
 
     def _wrap(self, script_path: str, rank: int, phase: str) -> str:
-        """Run the script in its own session and record the pgid so cancel
-        can kill the whole process tree (reference analog:
-        skylet/subprocess_daemon.py)."""
+        """Run the script under the native C++ supervisor (agent/native/
+        supervisor.cpp): process-tree kill on cancel (reference analog:
+        skylet/subprocess_daemon.py), timestamped on-host log copy, and a
+        heartbeat file for hung-host detection. Falls back to a setsid
+        wrapper where the binary couldn't be built."""
         pid_file = self._pid_file(rank, phase)
-        return (f'mkdir -p $(dirname {pid_file}); '
-                f'setsid bash {script_path} < /dev/null & pid=$!; '
-                f'echo $pid > {pid_file}; '
-                f'wait $pid')
+        job_dir = f'~/.skyt_agent/jobs/{self.job_id}'
+        return native.wrap_command(
+            script_path, pid_file,
+            log_file=f'{job_dir}/{phase}-rank{rank}.host.log',
+            heartbeat_file=f'{job_dir}/{phase}-rank{rank}.hb')
 
     def _stage_job(self) -> None:
         """Copy the job dir (scripts) from head to every worker host — the

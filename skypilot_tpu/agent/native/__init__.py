@@ -1,0 +1,83 @@
+"""Native agent components: build + locate the C++ job supervisor.
+
+The supervisor binary is compiled ON the host at runtime-setup time (TPU
+VMs are x86/ARM Linux with g++ in the base image; compiling on-host avoids
+shipping per-arch binaries the way the reference avoids it by being pure
+Python and leaning on Ray's prebuilt C++ core, SURVEY.md §2.9). If no
+compiler is available the executor falls back to the `setsid` shell
+wrapper — same contract, weaker tree-kill guarantees.
+"""
+from __future__ import annotations
+
+import os
+import pathlib
+import shlex
+import subprocess
+from typing import List, Optional
+
+_COMPILERS = ('g++', 'clang++', 'c++')
+_SRC = pathlib.Path(__file__).resolve().parent / 'supervisor.cpp'
+_BIN_DIR = '~/.skyt_agent/bin'
+_BIN_NAME = 'skyt_supervisor'
+
+
+def binary_path() -> str:
+    return os.path.join(os.path.expanduser(_BIN_DIR), _BIN_NAME)
+
+
+def ensure_built(force: bool = False,
+                 extra_flags: Optional[List[str]] = None) -> Optional[str]:
+    """Compile the supervisor if needed; returns the binary path or None
+    if no toolchain is available."""
+    out = binary_path()
+    if not force and os.path.exists(out) and (
+            os.path.getmtime(out) >= os.path.getmtime(_SRC)):
+        return out
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    for cxx in _COMPILERS:
+        try:
+            proc = subprocess.run(
+                [cxx, '-O2', '-std=c++17', '-o', out, str(_SRC)]
+                + (extra_flags or []),
+                capture_output=True, timeout=120, check=False)
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode == 0:
+            return out
+    return None
+
+
+def wrap_command(script_path: str, pid_file: str, log_file: str,
+                 heartbeat_file: Optional[str] = None,
+                 grace_seconds: int = 10) -> str:
+    """Shell line that runs `bash script_path` under the supervisor, with
+    a setsid fallback when the binary can't be built on this host.
+
+    Emitted as a single remote command; the binary check happens on the
+    REMOTE host at run time (the `[ -x ]` guard), not on the client.
+    """
+    sup = os.path.join(_BIN_DIR, _BIN_NAME)
+    hb = f' --heartbeat {heartbeat_file}' if heartbeat_file else ''
+    supervised = (f'{sup} --pidfile {pid_file} --logfile {log_file}'
+                  f'{hb} --grace-seconds {grace_seconds} '
+                  f'-- bash {script_path}')
+    fallback = (f'setsid bash {script_path} < /dev/null & pid=$!; '
+                f'echo $pid > {pid_file}; wait $pid')
+    return (f'mkdir -p $(dirname {pid_file}); '
+            f'if [ -x {sup} ]; then {supervised}; '
+            f'else {fallback}; fi')
+
+
+def remote_build_command(runtime_dir: str) -> str:
+    """Command run during runtime setup on every host: the package source
+    (including supervisor.cpp) is already rsynced into runtime_dir;
+    compile if a toolchain exists. Failure is non-fatal — the executor's
+    `[ -x ]` guard falls back to setsid."""
+    src = f'{runtime_dir}/skypilot_tpu/agent/native/supervisor.cpp'
+    out = f'{_BIN_DIR}/{_BIN_NAME}'
+    compilers = ' '.join(_COMPILERS)
+    return (f'mkdir -p {_BIN_DIR} && '
+            f'for cxx in {compilers}; do '
+            f'command -v $cxx >/dev/null 2>&1 && '
+            f'$cxx -O2 -std=c++17 -o {out} {src} 2>/dev/null && break; '
+            f'done; true')

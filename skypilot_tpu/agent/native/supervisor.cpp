@@ -1,0 +1,304 @@
+// skyt_supervisor — per-host job supervisor (native runtime component).
+//
+// Replaces two pieces of the reference's runtime:
+//   * the Ray worker process that `run_bash_command_with_log` executes
+//     under (sky/skylet/log_lib.py:138-277): spawn the user script,
+//     timestamp + persist its output, propagate the exit code;
+//   * subprocess_daemon.py (sky/skylet/subprocess_daemon.py): the
+//     double-forked reaper that guarantees the job's WHOLE process tree
+//     dies on cancel — here via PR_SET_CHILD_SUBREAPER + process-group
+//     SIGKILL escalation, no Python, no polling of /proc.
+//
+// Usage:
+//   skyt_supervisor --pidfile P --logfile L [--heartbeat H]
+//                   [--grace-seconds N] -- <cmd> [args...]
+//
+// Contract:
+//   * own pid -> pidfile; SIGTERM/SIGINT to that pid tears down the whole
+//     job tree (grace period, then SIGKILL to the child's process group).
+//   * child runs in its own process group; supervisor is a subreaper, so
+//     double-forking daemons cannot escape.
+//   * child stdout+stderr stream through: raw lines to our stdout (the
+//     SSH channel the head tails), "[ISO8601] line" to the logfile.
+//   * heartbeat file gets the epoch written atomically every 5 s while
+//     the child lives — the head's health prober reads staleness.
+//   * exit code: child's, or 128+signal if signalled.
+#include <cerrno>
+#include <cstdio>
+#include <dirent.h>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <string>
+#include <sys/prctl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <utility>
+#include <vector>
+
+namespace {
+
+volatile sig_atomic_t g_term_requested = 0;
+
+void on_term(int) { g_term_requested = 1; }
+
+void write_file_atomic(const std::string& path, const std::string& content) {
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  ssize_t unused = write(fd, content.c_str(), content.size());
+  (void)unused;
+  close(fd);
+  rename(tmp.c_str(), path.c_str());
+}
+
+std::string iso_now() {
+  char buf[64];
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  struct tm tm_buf;
+  localtime_r(&ts.tv_sec, &tm_buf);
+  size_t n = strftime(buf, sizeof(buf), "%Y-%m-%d %H:%M:%S", &tm_buf);
+  snprintf(buf + n, sizeof(buf) - n, ".%03ld", ts.tv_nsec / 1000000);
+  return std::string(buf);
+}
+
+struct Args {
+  std::string pidfile;
+  std::string logfile;
+  std::string heartbeat;
+  int grace_seconds = 10;
+  std::vector<char*> cmd;
+};
+
+bool parse_args(int argc, char** argv, Args* out) {
+  int i = 1;
+  for (; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a == "--") { ++i; break; }
+    if (a == "--pidfile" && i + 1 < argc) out->pidfile = argv[++i];
+    else if (a == "--logfile" && i + 1 < argc) out->logfile = argv[++i];
+    else if (a == "--heartbeat" && i + 1 < argc) out->heartbeat = argv[++i];
+    else if (a == "--grace-seconds" && i + 1 < argc)
+      out->grace_seconds = atoi(argv[++i]);
+    else {
+      fprintf(stderr, "skyt_supervisor: unknown arg %s\n", a.c_str());
+      return false;
+    }
+  }
+  for (; i < argc; ++i) out->cmd.push_back(argv[i]);
+  out->cmd.push_back(nullptr);
+  return out->cmd.size() > 1 && !out->pidfile.empty() &&
+         !out->logfile.empty();
+}
+
+// Flush one complete line to stdout (raw) + logfile (timestamped).
+void emit_line(FILE* logf, const std::string& line) {
+  fwrite(line.data(), 1, line.size(), stdout);
+  fputc('\n', stdout);
+  fflush(stdout);
+  if (logf) {
+    std::string stamped = "[" + iso_now() + "] " + line + "\n";
+    fwrite(stamped.data(), 1, stamped.size(), logf);
+    fflush(logf);
+  }
+}
+
+// SIGKILL every live descendant of `root` (walk /proc ppid chains).
+// Catches daemons that setsid'd out of the child's process group — the
+// case subprocess_daemon.py handles with psutil.children(recursive=True).
+void kill_descendants(pid_t root) {
+  DIR* proc = opendir("/proc");
+  if (!proc) return;
+  std::vector<std::pair<pid_t, pid_t>> procs;  // (pid, ppid)
+  struct dirent* ent;
+  while ((ent = readdir(proc)) != nullptr) {
+    pid_t pid = atoi(ent->d_name);
+    if (pid <= 0) continue;
+    char path[64];
+    snprintf(path, sizeof(path), "/proc/%d/stat", pid);
+    FILE* f = fopen(path, "r");
+    if (!f) continue;
+    // stat: pid (comm) state ppid ...  comm may contain spaces/parens;
+    // parse from the LAST ')'.
+    char line[512];
+    if (fgets(line, sizeof(line), f)) {
+      char* rp = strrchr(line, ')');
+      pid_t ppid = 0;
+      char state;
+      if (rp && sscanf(rp + 1, " %c %d", &state, &ppid) == 2)
+        procs.emplace_back(pid, ppid);
+    }
+    fclose(f);
+  }
+  closedir(proc);
+  // BFS from root over the ppid edges.
+  std::vector<pid_t> frontier = {root};
+  std::vector<pid_t> doomed;
+  while (!frontier.empty()) {
+    pid_t cur = frontier.back();
+    frontier.pop_back();
+    for (auto& pr : procs) {
+      if (pr.second == cur) {
+        doomed.push_back(pr.first);
+        frontier.push_back(pr.first);
+      }
+    }
+  }
+  for (pid_t p : doomed)
+    if (p != getpid()) kill(p, SIGKILL);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args args;
+  if (!parse_args(argc, argv, &args)) {
+    fprintf(stderr,
+            "usage: skyt_supervisor --pidfile P --logfile L "
+            "[--heartbeat H] [--grace-seconds N] -- cmd...\n");
+    return 2;
+  }
+
+  // Detach from the SSH session's group so a dropped connection doesn't
+  // SIGHUP the job; become a subreaper so re-parented grandchildren land
+  // on us instead of init (we reap them; their group dies with the child's
+  // pgid kill below).
+  setsid();  // may fail if already a leader; fine either way
+  prctl(PR_SET_CHILD_SUBREAPER, 1);
+  signal(SIGHUP, SIG_IGN);
+
+  // Handlers must be live BEFORE the pidfile exists: the instant the
+  // pidfile is visible, a cancel may signal us, and the default SIGTERM
+  // action would orphan the job tree.
+  struct sigaction sa = {};
+  sa.sa_handler = on_term;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+
+  write_file_atomic(args.pidfile, std::to_string(getpid()) + "\n");
+
+  FILE* logf = fopen(args.logfile.c_str(), "a");
+
+  int pipefd[2];
+  if (pipe(pipefd) != 0) { perror("pipe"); return 2; }
+
+  pid_t child = fork();
+  if (child < 0) { perror("fork"); return 2; }
+  if (child == 0) {
+    // Child: own process group (the kill target), stdout+stderr -> pipe.
+    setpgid(0, 0);
+    dup2(pipefd[1], STDOUT_FILENO);
+    dup2(pipefd[1], STDERR_FILENO);
+    close(pipefd[0]);
+    close(pipefd[1]);
+    int devnull = open("/dev/null", O_RDONLY);
+    if (devnull >= 0) dup2(devnull, STDIN_FILENO);
+    execvp(args.cmd[0], args.cmd.data());
+    fprintf(stderr, "skyt_supervisor: exec %s: %s\n", args.cmd[0],
+            strerror(errno));
+    _exit(127);
+  }
+  setpgid(child, child);  // race-free from both sides
+  close(pipefd[1]);
+
+  std::string buf;
+  char rdbuf[4096];
+  bool pipe_open = true;
+  int child_status = -1;
+  bool child_exited = false;
+  time_t last_heartbeat = 0;
+  time_t term_sent_at = 0;
+  time_t child_exit_time = 0;
+  // After the main script exits, background descendants holding the
+  // inherited stdout pipe get this long to flush before the tree dies.
+  // The job IS the script: its exit ends the job (reference semantics —
+  // run_with_log returns when the bash wrapper exits, log_lib.py:138).
+  const int kDrainSeconds = 2;
+
+  while (pipe_open || !child_exited) {
+    if (child_exited &&
+        time(nullptr) - child_exit_time >= kDrainSeconds) {
+      break;  // stragglers hold the pipe open; tree-kill below
+    }
+    // Heartbeat (epoch seconds), at most every 5 s.
+    time_t now = time(nullptr);
+    if (!args.heartbeat.empty() && !child_exited &&
+        now - last_heartbeat >= 5) {
+      write_file_atomic(args.heartbeat, std::to_string(now) + "\n");
+      last_heartbeat = now;
+    }
+
+    if (g_term_requested && term_sent_at == 0) {
+      emit_line(logf, "[skyt_supervisor] termination requested; "
+                      "signalling job process group");
+      kill(-child, SIGTERM);
+      term_sent_at = now;
+    }
+    if (term_sent_at != 0 && now - term_sent_at >= args.grace_seconds) {
+      kill(-child, SIGKILL);
+      kill_descendants(getpid());
+      term_sent_at = now;  // re-arm; repeated SIGKILL is harmless
+    }
+
+    if (pipe_open) {
+      struct pollfd pfd = {pipefd[0], POLLIN, 0};
+      int rc = poll(&pfd, 1, 1000);
+      if (rc > 0 && (pfd.revents & (POLLIN | POLLHUP))) {
+        ssize_t n = read(pipefd[0], rdbuf, sizeof(rdbuf));
+        if (n > 0) {
+          buf.append(rdbuf, n);
+          size_t pos;
+          while ((pos = buf.find('\n')) != std::string::npos) {
+            emit_line(logf, buf.substr(0, pos));
+            buf.erase(0, pos + 1);
+          }
+        } else if (n == 0) {
+          pipe_open = false;
+        } else if (errno != EINTR && errno != EAGAIN) {
+          pipe_open = false;
+        }
+      }
+    } else if (!child_exited) {
+      // Pipe closed but child (or a grandchild holding no pipe) lives on.
+      sleep(1);
+    }
+
+    // Reap: the child, plus any re-parented descendants (subreaper).
+    int status;
+    pid_t r;
+    while ((r = waitpid(-1, &status, WNOHANG)) > 0) {
+      if (r == child) {
+        child_status = status;
+        child_exited = true;
+        child_exit_time = time(nullptr);
+      }
+    }
+    if (r < 0 && errno == ECHILD && child_exited && !pipe_open) {
+      break;  // all descendants reaped and output drained
+    }
+  }
+  if (!buf.empty()) emit_line(logf, buf);
+
+  // The child is gone; take its whole group AND any session-escaped
+  // descendants with it (subprocess_daemon semantics).
+  kill(-child, SIGKILL);
+  kill_descendants(getpid());
+
+  int code;
+  if (WIFSIGNALED(child_status)) {
+    code = 128 + WTERMSIG(child_status);
+    emit_line(logf, "[skyt_supervisor] job killed by signal " +
+                        std::to_string(WTERMSIG(child_status)));
+  } else {
+    code = WEXITSTATUS(child_status);
+  }
+  if (logf) fclose(logf);
+  if (!args.heartbeat.empty()) unlink(args.heartbeat.c_str());
+  return code;
+}

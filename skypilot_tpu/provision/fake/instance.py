@@ -225,20 +225,49 @@ def _kill_host_processes(cluster_dir: pathlib.Path) -> None:
             pids.extend(r[0] for r in rows)
         except sqlite3.Error:
             continue
+    # A recorded pid may be the C++ supervisor (whose own group holds only
+    # itself — the job tree lives in the child's group and in
+    # setsid-escaped descendants), so a bare killpg(SIGKILL) would kill
+    # the supervisor and LEAK the tree. Sweep full descendant sets from
+    # /proc instead, then kill groups/pids as backstop.
     own_pgid = os.getpgid(0)
-    for pid in pids:
-        try:
-            pgid = os.getpgid(pid)
-        except (ProcessLookupError, PermissionError):
+    doomed: set = set()
+    ppids = _proc_ppid_map()
+    frontier = list(pids)
+    while frontier:
+        cur = frontier.pop()
+        for child_pid, ppid in ppids:
+            if ppid == cur and child_pid not in doomed:
+                doomed.add(child_pid)
+                frontier.append(child_pid)
+    for pid in set(pids) | doomed:
+        if pid == os.getpid():
             continue
         try:
+            pgid = os.getpgid(pid)
             if pgid == pid and pgid != own_pgid:
-                # setsid'd job tree: kill the whole group.
                 os.killpg(pgid, signal.SIGKILL)
-            elif pid != os.getpid():
+            else:
                 os.kill(pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+
+
+def _proc_ppid_map() -> list:
+    """[(pid, ppid)] snapshot from /proc (parse from the last ')' of
+    /proc/<pid>/stat — comm may contain spaces)."""
+    out = []
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f'/proc/{entry}/stat') as f:
+                stat = f.read()
+            after = stat.rsplit(')', 1)[1].split()
+            out.append((int(entry), int(after[1])))
+        except (OSError, IndexError, ValueError):
+            continue
+    return out
 
 
 def query_instances(cluster_name: str,

@@ -24,6 +24,7 @@ from skypilot_tpu import provision
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu.agent import constants as agent_constants
+from skypilot_tpu.agent import native
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner
 from skypilot_tpu.utils import subprocess_utils
@@ -155,6 +156,9 @@ def setup_runtime_on_cluster(info: common.ClusterInfo) -> None:
         runner.rsync(str(_PACKAGE_ROOT) + '/',
                      f'{agent_constants.RUNTIME_DIR}/skypilot_tpu/',
                      up=True)
+        # Build the native job supervisor (C++) on-host; best-effort.
+        runner.run(native.remote_build_command(agent_constants.RUNTIME_DIR),
+                   check=False)
 
     subprocess_utils.run_in_parallel(_setup_host, hosts)
 

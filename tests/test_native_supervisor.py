@@ -1,0 +1,140 @@
+"""Tests for the native C++ job supervisor (agent/native/supervisor.cpp).
+
+The reference delegates these semantics to Ray's C++ core + the
+subprocess_daemon reaper (sky/skylet/subprocess_daemon.py); here they are
+one small binary we can test directly: exit-code propagation, output
+streaming + timestamped log copy, heartbeat, SIGTERM tree teardown
+including setsid-escaped grandchildren.
+"""
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+from skypilot_tpu.agent import native
+
+
+@pytest.fixture(scope='module')
+def supervisor():
+    path = native.ensure_built()
+    if path is None:
+        pytest.skip('no C++ toolchain')
+    return path
+
+
+def _run(supervisor, tmp_path, cmd, **popen_kw):
+    pidfile = tmp_path / 'pid'
+    logfile = tmp_path / 'log'
+    hb = tmp_path / 'hb'
+    proc = subprocess.Popen(
+        [supervisor, '--pidfile', str(pidfile), '--logfile', str(logfile),
+         '--heartbeat', str(hb), '--grace-seconds', '1', '--',
+         'bash', '-c', cmd],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        **popen_kw)
+    return proc, pidfile, logfile, hb
+
+
+def test_exit_code_and_output(supervisor, tmp_path):
+    proc, pidfile, logfile, _ = _run(
+        supervisor, tmp_path, 'echo hello-out; echo hello-err >&2; exit 7')
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 7
+    assert 'hello-out' in out
+    assert 'hello-err' in out          # stderr merged into the stream
+    log = logfile.read_text()
+    assert 'hello-out' in log
+    # log copy is timestamped
+    assert log.splitlines()[0].startswith('[20')
+    assert pidfile.read_text().strip().isdigit()
+
+
+def test_heartbeat_written_and_cleared(supervisor, tmp_path):
+    proc, _, _, hb = _run(supervisor, tmp_path, 'sleep 7; echo done')
+    deadline = time.time() + 10
+    while not hb.exists() and time.time() < deadline:
+        time.sleep(0.2)
+    assert hb.exists(), 'heartbeat file never appeared'
+    epoch = int(hb.read_text().strip())
+    assert abs(epoch - time.time()) < 30
+    proc.wait(timeout=30)
+    assert not hb.exists(), 'heartbeat not cleaned up on exit'
+
+
+def test_sigterm_kills_process_tree(supervisor, tmp_path):
+    # Child spawns (a) a background grandchild in its pgroup (sleep 998)
+    # and (b) a setsid-escaped daemon grandchild (sleep 999); both must
+    # die on supervisor TERM. Distinct sleep args so the ps probe cannot
+    # match the supervisor's/child's own argv (which contains this cmd).
+    marker = tmp_path / 'escaped-daemon-survived'
+    cmd = (f'sleep 998 & '
+           f'setsid bash -c "sleep 999; touch {marker}" & '
+           f'echo started; sleep 997')
+    proc, pidfile, logfile, _ = _run(supervisor, tmp_path, cmd)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if pidfile.exists() and 'started' in (
+                logfile.read_text() if logfile.exists() else ''):
+            break
+        time.sleep(0.1)
+
+    def _sleepers(args):
+        out = subprocess.run(['ps', '-eo', 'args'], capture_output=True,
+                             text=True).stdout
+        return [l for l in out.splitlines()
+                if l.strip() in args]
+
+    deadline = time.time() + 5
+    while time.time() < deadline and len(
+            _sleepers({'sleep 998', 'sleep 999'})) < 2:
+        time.sleep(0.1)
+    assert len(_sleepers({'sleep 998', 'sleep 999'})) == 2, \
+        'grandchildren did not start'
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=30)
+    assert rc != 0                      # killed, not clean
+    time.sleep(2.5)                     # grace(1s) + escalation margin
+    leftovers = _sleepers({'sleep 997', 'sleep 998', 'sleep 999'})
+    assert not leftovers, f'leaked processes: {leftovers}'
+    assert not marker.exists()
+
+
+def test_background_daemon_dies_when_script_exits(supervisor, tmp_path):
+    # The job IS the script: when it exits, stragglers holding the stdout
+    # pipe open must not wedge the supervisor (2 s drain, then tree-kill).
+    cmd = 'sleep 996 & echo spawned; exit 0'
+    proc, _, _, _ = _run(supervisor, tmp_path, cmd)
+    out, _ = proc.communicate(timeout=30)   # must NOT hang
+    assert proc.returncode == 0
+    assert 'spawned' in out
+    time.sleep(0.5)
+    out = subprocess.run(['ps', '-eo', 'args'], capture_output=True,
+                         text=True).stdout
+    leaked = [l for l in out.splitlines() if l.strip() == 'sleep 996']
+    assert not leaked, 'background daemon outlived the job'
+
+
+def test_exec_failure_gives_127(supervisor, tmp_path):
+    pidfile = tmp_path / 'pid'
+    logfile = tmp_path / 'log'
+    proc = subprocess.Popen(
+        [supervisor, '--pidfile', str(pidfile), '--logfile', str(logfile),
+         '--', '/nonexistent-binary-xyz'],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    proc.communicate(timeout=30)
+    assert proc.returncode == 127
+
+
+def test_wrap_command_falls_back_without_binary(tmp_path):
+    # The emitted shell line must keep working on hosts with no compiler:
+    # force the [ -x ] guard down the fallback branch with a fake HOME.
+    cmd = native.wrap_command('script.sh', '~/.skyt_agent/pidf',
+                              '~/.skyt_agent/log')
+    (tmp_path / 'script.sh').write_text('echo fallback-ran; exit 3\n')
+    env = dict(os.environ, HOME=str(tmp_path))
+    proc = subprocess.run(['bash', '-c', cmd], capture_output=True,
+                          text=True, env=env, cwd=tmp_path, timeout=30)
+    assert proc.returncode == 3
+    assert 'fallback-ran' in proc.stdout
