@@ -218,7 +218,11 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
-           angles: jax.Array) -> jax.Array:
+           angles: jax.Array, return_kv: bool = False, cache=None):
+    """One transformer block, shared by training forward, prefill and
+    cached decode. `cache=(k_cache, v_cache, lengths)` switches attention
+    to the KV-cache path (q of length 1 against the full cache row);
+    `return_kv` additionally emits this layer's fresh k/v (prefill)."""
     b, s, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -228,7 +232,18 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     v = (attn_in @ layer_params['wv']).reshape(b, s, kv, hd)
     q = apply_rope(q, angles)
     k = apply_rope(k, angles)
-    attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
+    if cache is not None:
+        k_cache, v_cache, lengths = cache
+        k_cache = _write_slot(k_cache, k, lengths)
+        v_cache = _write_slot(v_cache, v, lengths)
+        k_cache = _shard(k_cache, KV_LAYER_SPEC)
+        v_cache = _shard(v_cache, KV_LAYER_SPEC)
+        attn_out = _cached_attention(q, k_cache, v_cache,
+                                     lengths).reshape(b, s, h * hd)
+        kv_out = (k_cache, v_cache)
+    else:
+        attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
+        kv_out = (k, v) if return_kv else None
     x = x + attn_out @ layer_params['wo']
     x = _shard(x, ACT_SPEC)
 
@@ -236,7 +251,8 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     gate = jax.nn.silu(mlp_in @ layer_params['w_gate'])
     up = mlp_in @ layer_params['w_up']
     x = x + (gate * up) @ layer_params['w_down']
-    return _shard(x, ACT_SPEC)
+    x = _shard(x, ACT_SPEC)
+    return x, kv_out
 
 
 def _shard(x: jax.Array, spec: P) -> jax.Array:
@@ -249,7 +265,8 @@ def _shard(x: jax.Array, spec: P) -> jax.Array:
 
 def forward(params: Params, tokens: jax.Array,
             cfg: LlamaConfig,
-            positions: Optional[jax.Array] = None) -> jax.Array:
+            positions: Optional[jax.Array] = None,
+            return_kv: bool = False):
     """tokens [B, S] int32 -> logits [B, S, V] float32."""
     b, s = tokens.shape
     if positions is None:
@@ -258,22 +275,110 @@ def forward(params: Params, tokens: jax.Array,
     x = params['embed'][tokens].astype(cfg.dtype)
     x = _shard(x, ACT_SPEC)
 
-    layer_fn = functools.partial(_layer, cfg)
-    if cfg.remat:
+    # Bind return_kv BEFORE any jax.checkpoint wrap: a bool passed through
+    # remat at call time would be traced and crash the `if return_kv`.
+    layer_fn = functools.partial(_layer, cfg, return_kv=return_kv)
+    if cfg.remat and not return_kv:
         layer_fn = jax.checkpoint(
             layer_fn,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
 
+    kv = None
     if cfg.scan_layers:
         def scan_body(carry, layer_params):
-            return layer_fn(carry, layer_params, angles), None
-        x, _ = jax.lax.scan(scan_body, x, params['layers'])
+            return layer_fn(carry, layer_params, angles)
+        x, kv = jax.lax.scan(scan_body, x, params['layers'])
     else:
+        ks, vs = [], []
         for i in range(cfg.n_layers):
             layer_params = jax.tree.map(lambda p: p[i], params['layers'])
-            x = layer_fn(x, layer_params, angles)
+            x, layer_kv = layer_fn(x, layer_params, angles)
+            if return_kv:
+                ks.append(layer_kv[0])
+                vs.append(layer_kv[1])
+        if return_kv:
+            kv = (jnp.stack(ks), jnp.stack(vs))
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
-    return _shard(logits, LOGITS_SPEC)
+    logits = _shard(logits, LOGITS_SPEC)
+    if return_kv:
+        return logits, {'k': kv[0], 'v': kv[1]}
+    return logits
+
+
+# Decode path (KV cache) ---------------------------------------------- #
+#
+# Serving counterpart of the reference's JetStream recipe
+# (reference examples/tpu/v6e/README.md:104-120): instead of shelling out
+# to an external engine, the cache layout and the single-token decode step
+# are in-framework. Layout:
+#     cache = {'k': [L, B, T, KV, hd], 'v': same}   (T = max_decode_len)
+# sharded P(None, batch, None, 'tp', None): one slot per batch row, KV
+# heads split over tp. `lengths[b]` counts tokens already in slot b; the
+# new token is written at index lengths[b] and attention masks t <=
+# lengths[b]. Everything is static-shape so the decode step compiles once.
+
+KV_CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
+KV_LAYER_SPEC = P(('dp', 'fsdp'), None, 'tp', None)   # per-layer slice
+
+
+def init_kv_cache(cfg: LlamaConfig, batch_size: int,
+                  max_len: int) -> Params:
+    shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads,
+             cfg.head_dim)
+    return {'k': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC),
+            'v': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC)}
+
+
+def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                      lengths: jax.Array) -> jax.Array:
+    """q [B,1,H,hd]; k/v_cache [B,T,KV,hd]; lengths [B] = index of the
+    token just written (attend to t <= lengths)."""
+    b, _, h, hd = q.shape
+    t = k_cache.shape[1]
+    kv_heads = k_cache.shape[2]
+    group = h // kv_heads
+    q = q.reshape(b, kv_heads, group, hd)
+    scores = jnp.einsum('bkgh,btkh->bkgt', q, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(hd).astype(jnp.float32)
+    mask = jnp.arange(t)[None] <= lengths[:, None]          # [B, T]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bkgt,btkh->bkgh', probs.astype(v_cache.dtype),
+                     v_cache)
+    return out.reshape(b, 1, h, hd)
+
+
+def _write_slot(cache: jax.Array, new: jax.Array,
+                lengths: jax.Array) -> jax.Array:
+    """Write new [B,1,KV,hd] at per-row index lengths[b] of [B,T,KV,hd]."""
+    def one(c, n, i):
+        return jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
+    return jax.vmap(one)(cache, new, lengths)
+
+
+def decode_step(params: Params, cache: Params, lengths: jax.Array,
+                tokens: jax.Array, cfg: LlamaConfig):
+    """One token for every slot. tokens [B] int32, lengths [B] = #tokens
+    already cached per slot. Returns (logits [B, V] fp32, new_cache)."""
+    angles = jax.vmap(
+        lambda p: rope_frequencies(cfg, p[None]))(lengths)    # [B,1,half]
+
+    x = params['embed'][tokens][:, None].astype(cfg.dtype)    # [B,1,D]
+
+    def body(carry, xs):
+        layer_params, k_cache, v_cache = xs
+        x, (k_cache, v_cache) = _layer(
+            cfg, carry, layer_params, angles,
+            cache=(k_cache, v_cache, lengths))
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params['layers'], cache['k'], cache['v']))
+    x = rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], {'k': new_k, 'v': new_v}

@@ -1,0 +1,277 @@
+"""TPU-native LLM serving engine: prefill / insert / generate.
+
+The reference serves LLMs by shelling out to JetStream/vLLM in recipe
+YAMLs (reference examples/tpu/v6e/README.md:104-120, llm/mixtral/serve.yaml);
+the serving engine itself lives outside the framework. Here it is a
+first-class component, JetStream-shaped but in-repo:
+
+  * **prefill**: run the full forward over a (bucket-padded) prompt once,
+    returning the prompt's KV cache and the first generated token. One
+    compile per bucket size.
+  * **insert**: copy a prefill result into a free decode slot (row of the
+    batched KV cache) with `dynamic_update_slice`.
+  * **generate**: one fused decode step for ALL slots (models/llama.py
+    `decode_step`): static shapes, one compile, every token for every
+    active request in a single device program — continuous batching.
+
+The host-side loop (`Engine.run_loop` / `generate_batch`) owns slot
+assignment: requests queue up, finished slots are refilled without
+draining the batch. Per step exactly one small device->host transfer
+(the [B] token vector) happens.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.models import llama
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Decode-side knobs (model shape lives in LlamaConfig)."""
+    batch_size: int = 8               # concurrent decode slots
+    max_decode_len: int = 1024        # cache length per slot
+    prefill_buckets: Tuple[int, ...] = (16, 64, 256, 1024)
+    eos_id: int = -1                  # -1: never stop on a token
+    temperature: float = 0.0          # 0 => greedy
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    prompt_len: int
+    tokens: List[int]                 # generated so far
+    max_new_tokens: int
+    out_queue: Optional[Any] = None   # streaming sink (queue.Queue)
+
+
+class Engine:
+    """Batched decode engine over one model + one KV cache."""
+
+    def __init__(self, model_cfg: llama.LlamaConfig,
+                 params: Optional[llama.Params] = None,
+                 engine_cfg: Optional[EngineConfig] = None,
+                 seed: int = 0):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg or EngineConfig()
+        # A prefill bucket longer than the cache could not be inserted;
+        # clamp so every bucket fits (prompt + >=1 generated token).
+        self._buckets = tuple(sorted(
+            {min(b, self.cfg.max_decode_len - 1)
+             for b in self.cfg.prefill_buckets}))
+        if params is None:
+            params = llama.init_params(jax.random.PRNGKey(seed), model_cfg)
+        self.params = params
+        b, t = self.cfg.batch_size, self.cfg.max_decode_len
+        self._cache = llama.init_kv_cache(model_cfg, b, t)
+        self._lengths = jnp.zeros((b,), jnp.int32)
+        self._tokens = jnp.zeros((b,), jnp.int32)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._step_count = 0
+
+        self._prefill_jit = jax.jit(
+            functools.partial(self._prefill_impl, cfg=model_cfg),
+            static_argnames=())
+        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._decode_jit = jax.jit(
+            functools.partial(self._decode_impl, cfg=model_cfg),
+            donate_argnums=(1,))
+
+    # -- device programs ------------------------------------------------ #
+
+    @staticmethod
+    def _sample(logits: jax.Array, key: jax.Array,
+                temperature: float) -> jax.Array:
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def _prefill_impl(self, params, tokens, true_len, key, cfg):
+        """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
+        logits, kv = llama.forward(params, tokens, cfg, return_kv=True)
+        last = logits[0, true_len - 1]
+        tok = self._sample(last[None], key, self.cfg.temperature)[0]
+        return tok, kv
+
+    def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
+                     first_token):
+        """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`."""
+        new_cache = {}
+        for name in ('k', 'v'):
+            src = jnp.swapaxes(prefix_kv[name], 0, 1)  # [1,L,S,KV,hd]
+            dst = jnp.swapaxes(cache[name], 0, 1)      # [B,L,T,KV,hd]
+            dst = jax.lax.dynamic_update_slice(
+                dst, src.astype(dst.dtype), (slot, 0, 0, 0, 0))
+            new_cache[name] = jnp.swapaxes(dst, 0, 1)
+        lengths = lengths.at[slot].set(length)
+        tokens = tokens.at[slot].set(first_token)
+        return new_cache, lengths, tokens
+
+    def _decode_impl(self, params, cache, lengths, tokens, key, cfg):
+        logits, new_cache = llama.decode_step(params, cache, lengths,
+                                              tokens, cfg)
+        next_tokens = self._sample(logits, key, self.cfg.temperature)
+        return next_tokens, new_cache, lengths + 1
+
+    # -- host-side API --------------------------------------------------- #
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f'prompt length {n} exceeds largest prefill bucket '
+            f'{self._buckets[-1]}')
+
+    def prefill(self, prompt: Sequence[int]) -> Tuple[int, Any]:
+        """Returns (first generated token, prefix kv) for one prompt."""
+        if not prompt:
+            raise ValueError('empty prompt')
+        if len(prompt) >= self.cfg.max_decode_len:
+            raise ValueError('prompt longer than max_decode_len')
+        bucket = self._bucket(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        self._key, sub = jax.random.split(self._key)
+        tok, kv = self._prefill_jit(self.params, jnp.asarray(padded),
+                                    len(prompt), sub)
+        return int(tok), kv
+
+    def insert(self, prefix_kv: Any, slot: int, length: int,
+               first_token: int) -> None:
+        self._cache, self._lengths, self._tokens = self._insert_jit(
+            self._cache, prefix_kv, slot, length, self._lengths,
+            self._tokens, first_token)
+
+    def decode(self) -> np.ndarray:
+        """One decode step for every slot; returns the [B] token vector."""
+        self._key, sub = jax.random.split(self._key)
+        next_tokens, self._cache, self._lengths = self._decode_jit(
+            self.params, self._cache, self._lengths, self._tokens, sub)
+        self._tokens = next_tokens
+        self._step_count += 1
+        return np.asarray(jax.device_get(next_tokens))
+
+    # -- continuous batching --------------------------------------------- #
+
+    def generate_batch(self, prompts: Sequence[Sequence[int]],
+                       max_new_tokens: int = 32) -> List[List[int]]:
+        """Offline API: all prompts through the continuous-batching loop;
+        slots are refilled as requests finish (no drain barrier)."""
+        results: Dict[int, List[int]] = {}
+        pending = list(enumerate(prompts))[::-1]   # pop() takes req 0 first
+        slots: Dict[int, _Slot] = {}
+
+        while pending or slots:
+            free = [s for s in range(self.cfg.batch_size)
+                    if s not in slots]
+            while pending and free:
+                req_id, prompt = pending.pop()
+                slot_id = free.pop(0)
+                first, kv = self.prefill(prompt)
+                self.insert(kv, slot_id, len(prompt), first)
+                slots[slot_id] = _Slot(req_id, len(prompt), [first],
+                                       max_new_tokens)
+                self._finish_if_done(slots, slot_id, results)
+            if not slots:
+                continue
+            tokens = self.decode()
+            for slot_id in list(slots):
+                slot = slots[slot_id]
+                tok = int(tokens[slot_id])
+                slot.tokens.append(tok)
+                self._finish_if_done(slots, slot_id, results)
+        return [results[i] for i in range(len(prompts))]
+
+    def _finish_if_done(self, slots: Dict[int, _Slot], slot_id: int,
+                        results: Optional[Dict[int, List[int]]]) -> None:
+        slot = slots[slot_id]
+        done = (len(slot.tokens) >= slot.max_new_tokens
+                or slot.tokens[-1] == self.cfg.eos_id
+                or slot.prompt_len + len(slot.tokens)
+                >= self.cfg.max_decode_len - 1)
+        if done:
+            out = slot.tokens
+            if self.cfg.eos_id >= 0 and out and out[-1] == self.cfg.eos_id:
+                out = out[:-1]
+            if results is not None:
+                results[slot.request_id] = out
+            if slot.out_queue is not None:
+                slot.out_queue.put(None)        # end-of-stream
+            del slots[slot_id]
+
+    # -- online loop (used by the model server) -------------------------- #
+
+    def run_loop(self, request_queue: 'queue.Queue',
+                 stop: threading.Event) -> None:
+        """Continuous loop: pull (prompt, max_new, out_queue) requests,
+        stream generated tokens into out_queue (an Exception then None on
+        invalid input; None terminates the stream), refill slots as they
+        free up in strict arrival order. Idles (blocking get) when no
+        request is in flight."""
+        slots: Dict[int, _Slot] = {}
+        waiting: collections.deque = collections.deque()
+        next_id = 0
+        while not stop.is_set():
+            # Drain the queue into a local FIFO (block only when idle).
+            block = not slots and not waiting
+            try:
+                while True:
+                    item = request_queue.get(block=block, timeout=0.2)
+                    if item is None:
+                        stop.set()
+                        break
+                    waiting.append(item)
+                    block = False
+            except queue.Empty:
+                pass
+            if stop.is_set():
+                break
+            # Admit in arrival order while slots are free. A bad request
+            # must not kill the loop: report it and move on.
+            while waiting:
+                free = [s for s in range(self.cfg.batch_size)
+                        if s not in slots]
+                if not free:
+                    break
+                prompt, max_new, out_q = waiting.popleft()
+                try:
+                    first, kv = self.prefill(prompt)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning('rejecting request: %s', e)
+                    if out_q is not None:
+                        out_q.put(e)
+                        out_q.put(None)
+                    continue
+                slot_id = free[0]
+                self.insert(kv, slot_id, len(prompt), first)
+                slots[slot_id] = _Slot(next_id, len(prompt), [first],
+                                       max_new, out_q)
+                next_id += 1
+                if not (self.cfg.eos_id >= 0 and first == self.cfg.eos_id):
+                    out_q.put(first)
+                self._finish_if_done(slots, slot_id, None)
+            if not slots:
+                continue
+            tokens = self.decode()
+            for slot_id in list(slots):
+                slot = slots[slot_id]
+                tok = int(tokens[slot_id])
+                slot.tokens.append(tok)
+                if not (self.cfg.eos_id >= 0 and tok == self.cfg.eos_id):
+                    if slot.out_queue is not None:
+                        slot.out_queue.put(tok)
+                self._finish_if_done(slots, slot_id, None)

@@ -1,0 +1,178 @@
+"""HTTP model server wrapping serve/engine.py — the replica workload.
+
+The reference's serve replicas run arbitrary user commands (vLLM,
+JetStream, TGI — llm/mixtral/serve.yaml); readiness is probed over HTTP
+(reference sky/serve/replica_managers.py:1026-1130). This server is the
+in-framework equivalent workload: start it as the `run:` command of a
+service task and point `readiness_probe: /health` at it.
+
+Endpoints:
+    GET  /health              -> 200 once the engine compiled a step
+    POST /generate            -> {"prompt": [ids] | "text", "max_new_tokens": N}
+                                 returns {"tokens": [...], "text": "..."}
+
+Tokenization is byte-level (UTF-8 byte + 3 reserved ids) so demos work
+without shipping a tokenizer asset; real deployments pass token ids.
+"""
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import queue
+import threading
+from typing import List
+
+import jax
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+
+logger = sky_logging.init_logger(__name__)
+
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+_BYTE_OFFSET = 3
+
+
+def encode_text(text: str) -> List[int]:
+    return [BOS_ID] + [b + _BYTE_OFFSET for b in text.encode('utf-8')]
+
+
+def decode_tokens(tokens: List[int]) -> str:
+    data = bytes(t - _BYTE_OFFSET for t in tokens
+                 if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
+    return data.decode('utf-8', errors='replace')
+
+
+MODEL_PRESETS = {
+    'tiny': llama.llama_tiny,
+    'llama3-1b': llama.llama3_1b,
+    'llama3-8b': llama.llama3_8b,
+}
+
+
+class ModelServer:
+
+    def __init__(self, model: str = 'tiny', port: int = 8000,
+                 batch_size: int = 8, max_decode_len: int = 1024,
+                 temperature: float = 0.0):
+        cfg = MODEL_PRESETS[model]()
+        # Byte-level vocab must fit.
+        self.engine = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=batch_size, max_decode_len=max_decode_len,
+                eos_id=EOS_ID, temperature=temperature))
+        self.port = port
+        self.ready = threading.Event()
+        self.request_queue: queue.Queue = queue.Queue()
+        self.stop = threading.Event()
+        self._httpd = None
+
+    def _warmup(self) -> None:
+        first, kv = self.engine.prefill([BOS_ID])
+        self.engine.insert(kv, 0, 1, first)
+        self.engine.decode()
+        # Reset state after warm-up compile.
+        self.engine._lengths = self.engine._lengths * 0
+        self.ready.set()
+        logger.info('engine warmed up; serving on :%d', self.port)
+
+    def serve_forever(self) -> None:
+        self._warmup()
+        loop = threading.Thread(
+            target=self.engine.run_loop,
+            args=(self.request_queue, self.stop), daemon=True)
+        loop.start()
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *args):
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == '/health':
+                    if server.ready.is_set():
+                        self._json(200, {'status': 'ok'})
+                    else:
+                        self._json(503, {'status': 'warming up'})
+                else:
+                    self._json(404, {'error': 'not found'})
+
+            def do_POST(self):
+                if self.path != '/generate':
+                    self._json(404, {'error': 'not found'})
+                    return
+                length = int(self.headers.get('Content-Length', 0))
+                try:
+                    req = json.loads(self.rfile.read(length) or b'{}')
+                    prompt = req.get('prompt')
+                    if isinstance(prompt, str):
+                        tokens = encode_text(prompt)
+                    elif isinstance(prompt, list):
+                        tokens = [int(t) for t in prompt]
+                    else:
+                        raise ValueError('prompt must be str or [int]')
+                    max_new = int(req.get('max_new_tokens', 64))
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._json(400, {'error': str(e)})
+                    return
+                out_q: queue.Queue = queue.Queue()
+                server.request_queue.put((tokens, max_new, out_q))
+                toks: List[int] = []
+                error = None
+                while True:
+                    item = out_q.get()
+                    if item is None:
+                        break
+                    if isinstance(item, Exception):
+                        error = item
+                        continue
+                    toks.append(item)
+                if error is not None:
+                    self._json(400, {'error': str(error)})
+                    return
+                self._json(200, {'tokens': toks,
+                                 'text': decode_tokens(toks)})
+
+        class ThreadingServer(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._httpd = ThreadingServer(('0.0.0.0', self.port), Handler)
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.stop.set()
+            self.request_queue.put(None)
+
+    def shutdown(self) -> None:
+        self.stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--model', default='tiny',
+                        choices=sorted(MODEL_PRESETS))
+    parser.add_argument('--port', type=int, default=8000)
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--max-decode-len', type=int, default=1024)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    args = parser.parse_args()
+    logger.info('devices: %s', jax.devices())
+    ModelServer(args.model, args.port, args.batch_size,
+                args.max_decode_len, args.temperature).serve_forever()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,127 @@
+"""Model server HTTP surface: health, generate (ids + text)."""
+import json
+import socket
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import engine_server
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope='module')
+def server():
+    port = _free_port()
+    srv = engine_server.ModelServer.__new__(engine_server.ModelServer)
+    cfg = llama.LlamaConfig(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    srv.engine = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(16, 64),
+            eos_id=engine_server.EOS_ID))
+    srv.port = port
+    srv.ready = threading.Event()
+    import queue
+    srv.request_queue = queue.Queue()
+    srv.stop = threading.Event()
+    srv._httpd = None
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    srv.ready.wait(timeout=120)
+    yield srv, cfg
+    srv.shutdown()
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{port}/generate',
+        data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def test_health(server):
+    srv, _ = server
+    with urllib.request.urlopen(
+            f'http://127.0.0.1:{srv.port}/health', timeout=10) as resp:
+        assert json.loads(resp.read())['status'] == 'ok'
+
+
+def test_generate_token_ids(server):
+    srv, cfg = server
+    out = _post(srv.port, {'prompt': [5, 9, 23], 'max_new_tokens': 4})
+    assert len(out['tokens']) <= 4 and out['tokens']
+
+
+def test_generate_text_roundtrip(server):
+    srv, _ = server
+    out = _post(srv.port, {'prompt': 'hi', 'max_new_tokens': 4})
+    assert isinstance(out['text'], str)
+
+
+def test_bad_request(server):
+    srv, _ = server
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{srv.port}/generate',
+        data=json.dumps({'prompt': 42}).encode())
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+
+
+def test_invalid_prompt_rejected_loop_survives(server):
+    srv, _ = server
+    # Empty prompt: loop must reject with 400 and keep serving.
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{srv.port}/generate',
+        data=json.dumps({'prompt': [], 'max_new_tokens': 2}).encode())
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+    # Over-long prompt (> largest bucket): same.
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{srv.port}/generate',
+        data=json.dumps({'prompt': [1] * 300}).encode())
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+    # Still alive.
+    out = _post(srv.port, {'prompt': [5, 9], 'max_new_tokens': 2})
+    assert out['tokens']
+
+
+def test_bucket_clamped_to_cache():
+    import jax.numpy as jnp_
+    from skypilot_tpu.models import llama as llama_
+    cfg = llama_.LlamaConfig(
+        vocab_size=128, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        ffn_dim=64, max_seq_len=256, dtype=jnp_.float32, remat=False,
+        use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=1, max_decode_len=32,
+            prefill_buckets=(16, 64, 256)))
+    # Buckets beyond the cache collapse to max_decode_len - 1.
+    assert eng._buckets == (16, 31)
+    [out] = eng.generate_batch([[1] * 20], max_new_tokens=2)
+    assert len(out) == 2
+
+
+def test_byte_tokenizer_roundtrip():
+    text = 'hello, TPU ❤'
+    ids = engine_server.encode_text(text)
+    assert ids[0] == engine_server.BOS_ID
+    assert engine_server.decode_tokens(ids) == text
