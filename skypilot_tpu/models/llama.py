@@ -223,6 +223,26 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     cached decode. `cache=(k_cache, v_cache, lengths)` switches attention
     to the KV-cache path (q of length 1 against the full cache row);
     `return_kv` additionally emits this layer's fresh k/v (prefill)."""
+    x, kv_out = attention_block(cfg, x, layer_params, angles,
+                                return_kv=return_kv, cache=cache)
+
+    mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
+    gate = jax.nn.silu(mlp_in @ layer_params['w_gate'])
+    up = mlp_in @ layer_params['w_up']
+    x = x + (gate * up) @ layer_params['w_down']
+    x = _shard(x, ACT_SPEC)
+    return x, kv_out
+
+
+from skypilot_tpu.parallel.mesh import shard as _shard  # noqa: E402
+
+
+def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
+                    angles: jax.Array, return_kv: bool = False,
+                    cache=None):
+    """Pre-norm attention sub-block with residual: the piece shared by
+    Llama and the MoE models (mixtral swaps only the FFN). Returns
+    (x_after_residual, kv_out) with kv semantics as in `_layer`."""
     b, s, d = x.shape
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
@@ -245,22 +265,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
         attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
         kv_out = (k, v) if return_kv else None
     x = x + attn_out @ layer_params['wo']
-    x = _shard(x, ACT_SPEC)
-
-    mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
-    gate = jax.nn.silu(mlp_in @ layer_params['w_gate'])
-    up = mlp_in @ layer_params['w_up']
-    x = x + (gate * up) @ layer_params['w_down']
-    x = _shard(x, ACT_SPEC)
-    return x, kv_out
-
-
-def _shard(x: jax.Array, spec: P) -> jax.Array:
-    """with_sharding_constraint if we're under a mesh; no-op otherwise."""
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, RuntimeError):
-        return x
+    return _shard(x, ACT_SPEC), kv_out
 
 
 def forward(params: Params, tokens: jax.Array,

@@ -1,0 +1,222 @@
+"""Mixtral-family sparse-MoE transformer in pure functional JAX.
+
+The reference serves Mixtral by shelling out to vLLM with tensor
+parallelism (reference llm/mixtral/README.md, serve.yaml:40) and has no
+in-framework MoE. Here Mixtral is a first-class model: the attention path
+is shared with models/llama.py (GQA + RoPE + flash attention), the FFN is
+the sparse-MoE op (ops/moe.py) with experts sharded over the 'ep' mesh
+axis, and the whole body is one `lax.scan` over stacked layer weights like
+Llama so compile time stays flat in depth.
+
+forward() returns (logits, aux_loss): the router load-balance + z losses
+must be added to the task loss during training (train/trainer.py does this
+via the model module's `make_loss_fn`).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import moe
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash_attention: bool = True
+    scan_layers: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def moe(self) -> moe.MoEConfig:
+        return moe.MoEConfig(num_experts=self.num_experts,
+                             top_k=self.top_k,
+                             capacity_factor=self.capacity_factor)
+
+    def _attn_cfg(self) -> llama.LlamaConfig:
+        """Llama-config view for the shared attention helpers."""
+        return llama.LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim,
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, ffn_dim=self.ffn_dim,
+            max_seq_len=self.max_seq_len, rope_theta=self.rope_theta,
+            norm_eps=self.norm_eps, dtype=self.dtype,
+            use_flash_attention=self.use_flash_attention)
+
+    @property
+    def num_params(self) -> int:
+        d, f, l, v = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
+        kvd = self.n_kv_heads * self.head_dim
+        per_layer = (2 * d * d + 2 * d * kvd          # attention
+                     + d * self.num_experts           # router
+                     + self.num_experts * 3 * d * f   # experts
+                     + 2 * d)                         # norms
+        return v * d * 2 + l * per_layer + d
+
+    @property
+    def num_active_params(self) -> int:
+        """Params touched per token (top_k experts only) — the MFU basis."""
+        d, f, l, v = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
+        kvd = self.n_kv_heads * self.head_dim
+        per_layer = (2 * d * d + 2 * d * kvd + d * self.num_experts
+                     + self.top_k * 3 * d * f + 2 * d)
+        return v * d * 2 + l * per_layer + d
+
+    def flops_per_token(self, seq_len: int) -> float:
+        return (6.0 * self.num_active_params
+                + 12.0 * self.n_layers * self.dim * seq_len)
+
+
+def mixtral_8x7b() -> MixtralConfig:
+    return MixtralConfig()
+
+
+def mixtral_tiny() -> MixtralConfig:
+    """Structure-preserving toy config for tests / compile checks."""
+    return MixtralConfig(vocab_size=512, dim=128, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=256, num_experts=4,
+                         top_k=2, max_seq_len=512, rope_theta=10000.0,
+                         use_flash_attention=False)
+
+
+# Params -------------------------------------------------------------- #
+
+def init_params(key: jax.Array, cfg: MixtralConfig) -> Params:
+    d, f, l, v = cfg.dim, cfg.ffn_dim, cfg.n_layers, cfg.vocab_size
+    hd, nh, nkv, e = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads, cfg.num_experts
+    keys = jax.random.split(key, 10)
+
+    def norm_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) /
+                jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        'embed': norm_init(keys[0], (v, d), d),
+        'layers': {
+            'wq': norm_init(keys[1], (l, d, nh * hd), d),
+            'wk': norm_init(keys[2], (l, d, nkv * hd), d),
+            'wv': norm_init(keys[3], (l, d, nkv * hd), d),
+            'wo': norm_init(keys[4], (l, nh * hd, d), nh * hd),
+            # Router stays fp32: tiny, and routing decisions are
+            # numerically sensitive.
+            'w_router': (jax.random.normal(keys[5], (l, d, e), jnp.float32)
+                         / jnp.sqrt(d)),
+            'w_gate': norm_init(keys[6], (l, e, d, f), d),
+            'w_up': norm_init(keys[7], (l, e, d, f), d),
+            'w_down': norm_init(keys[8], (l, e, f, d), f),
+            'ln_attn': jnp.ones((l, d), cfg.dtype),
+            'ln_mlp': jnp.ones((l, d), cfg.dtype),
+        },
+        'final_norm': jnp.ones((d,), cfg.dtype),
+        'lm_head': norm_init(keys[9], (v, d), d),
+    }
+
+
+def param_shardings(cfg: MixtralConfig) -> Params:
+    """Attention like Llama (fsdp x tp); experts over 'ep', with the
+    per-expert matrices additionally fsdp x tp sharded."""
+    del cfg
+    return {
+        'embed': P('tp', 'fsdp'),
+        'layers': {
+            'wq': P(None, 'fsdp', 'tp'),
+            'wk': P(None, 'fsdp', 'tp'),
+            'wv': P(None, 'fsdp', 'tp'),
+            'wo': P(None, 'tp', 'fsdp'),
+            'w_router': P(None, 'fsdp', None),
+            'w_gate': P(None, 'ep', 'fsdp', 'tp'),
+            'w_up': P(None, 'ep', 'fsdp', 'tp'),
+            'w_down': P(None, 'ep', 'tp', 'fsdp'),
+            'ln_attn': P(None, None),
+            'ln_mlp': P(None, None),
+        },
+        'final_norm': P(None),
+        'lm_head': P('tp', 'fsdp'),
+    }
+
+
+# Model --------------------------------------------------------------- #
+
+def _layer(cfg: MixtralConfig, x: jax.Array, layer_params: Params,
+           angles: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One block: shared-attention + sparse-MoE FFN. Returns (x, aux)."""
+    x, _ = llama.attention_block(cfg._attn_cfg(), x, layer_params, angles)
+
+    mlp_in = llama.rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
+    moe_out, aux = moe.sparse_moe(
+        mlp_in, layer_params['w_router'], layer_params['w_gate'],
+        layer_params['w_up'], layer_params['w_down'], cfg.moe)
+    x = x + moe_out
+    x = llama._shard(x, llama.ACT_SPEC)
+    return x, aux
+
+
+def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
+            positions: Optional[jax.Array] = None
+            ) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, S] int32 -> (logits [B, S, V] fp32, aux loss scalar)."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)
+    angles = llama.rope_frequencies(cfg._attn_cfg(), positions)
+    x = params['embed'][tokens].astype(cfg.dtype)
+    x = llama._shard(x, llama.ACT_SPEC)
+
+    layer_fn = functools.partial(_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+
+    if cfg.scan_layers:
+        def scan_body(carry, layer_params):
+            return layer_fn(carry, layer_params, angles)
+        x, aux_per_layer = jax.lax.scan(scan_body, x, params['layers'])
+        aux = jnp.sum(aux_per_layer)
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        for i in range(cfg.n_layers):
+            layer_params = jax.tree.map(lambda p: p[i], params['layers'])
+            x, layer_aux = layer_fn(x, layer_params, angles)
+            aux = aux + layer_aux
+
+    x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    logits = llama._shard(logits, llama.LOGITS_SPEC)
+    return logits, aux
+
+
+def make_loss_fn(cfg: MixtralConfig):
+    """Next-token CE + router aux losses; trainer-compatible signature."""
+    from skypilot_tpu.train import trainer
+
+    def loss_fn(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits, aux = forward(params, inputs, cfg)
+        return trainer.cross_entropy_loss(logits, targets) + aux
+    return loss_fn

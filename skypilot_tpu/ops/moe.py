@@ -1,0 +1,144 @@
+"""Sparse mixture-of-experts layer, TPU-first.
+
+The reference has no MoE support in-framework — its Mixtral story is a
+recipe YAML that shells out to vLLM with `--tensor-parallel-size`
+(reference llm/mixtral/serve.yaml:40). Here MoE is a framework op built
+the XLA way: top-k routing is expressed as dense one-hot dispatch/combine
+einsums with a static token capacity per expert, so the whole layer is
+three batched matmuls + two dispatch einsums — all static shapes, all MXU
+work, and when the expert axis is sharded over the 'ep' mesh axis
+(parallel/mesh.py) XLA lowers the dispatch einsums to all-to-all over ICI.
+
+This is the GShard/Switch dispatch formulation (tokens over capacity are
+dropped and ride the residual connection), which on TPU beats gather/
+scatter routing because it avoids dynamic shapes entirely.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    # capacity = top_k * tokens / num_experts * capacity_factor, so 1.0 is
+    # "exactly enough slots if routing were perfectly balanced".
+    capacity_factor: float = 1.25
+    # Aux loss weights (Switch Transformer defaults).
+    load_balance_weight: float = 1e-2
+    router_z_weight: float = 1e-3
+
+
+def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
+    cap = int(cfg.top_k * num_tokens * cfg.capacity_factor
+              / cfg.num_experts) + 1
+    # Round up to a multiple of 8 (sublane) so the expert batch tiles.
+    return max(8, -(-cap // 8) * 8)
+
+
+def _top_k_dispatch(probs: jax.Array, cfg: MoEConfig, capacity: int
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """probs [T, E] -> (dispatch [T, E, C] 0/1 f32, combine [T, E, C],
+    assigned [T, E] pre-capacity top-k assignment counts).
+
+    Position-in-expert is a cumulative sum over the token axis per k-slot,
+    with later slots offset by earlier slots' per-expert counts (GShard
+    ordering: all slot-0 assignments get capacity before any slot-1).
+    `assigned` is returned for the load-balance loss, which must see the
+    routing decisions BEFORE capacity drops (Switch eq. 4) — otherwise the
+    penalty saturates exactly when routing is most imbalanced.
+    """
+    t, e = probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)   # [T, K]
+    # Renormalize the kept gates (Mixtral-style).
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.int32)        # slots used per expert
+    assigned = jnp.zeros((t, e), jnp.float32)  # pre-drop assignments
+    for k in range(cfg.top_k):
+        mask_k = jax.nn.one_hot(gate_idx[:, k], e, dtype=jnp.int32)  # [T,E]
+        assigned = assigned + mask_k.astype(jnp.float32)
+        pos_k = jnp.cumsum(mask_k, axis=0) - 1 + counts[None, :]     # [T,E]
+        counts = counts + jnp.sum(mask_k, axis=0)
+        keep = (mask_k > 0) & (pos_k < capacity)                     # [T,E]
+        pos_oh = jax.nn.one_hot(pos_k, capacity,
+                                dtype=jnp.float32)                  # [T,E,C]
+        d_k = pos_oh * keep[..., None]
+        dispatch = dispatch + d_k
+        combine = combine + d_k * gate_vals[:, k, None, None]
+    return dispatch, combine, assigned
+
+
+def aux_losses(probs: jax.Array, router_logits: jax.Array,
+               assigned: jax.Array, cfg: MoEConfig) -> jax.Array:
+    """Load-balance loss (Switch eq. 4) + router z-loss, pre-weighted.
+
+    `assigned` [T, E] counts pre-capacity top-k assignments per token."""
+    e = probs.shape[-1]
+    frac = jnp.mean(assigned, axis=0)                         # [E]
+    mean_prob = jnp.mean(probs, axis=0)                       # [E]
+    lb = e * jnp.sum(frac * mean_prob) / cfg.top_k
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    return cfg.load_balance_weight * lb + cfg.router_z_weight * z
+
+
+# Shardings: token dim over the data axes, expert dim over 'ep'.
+TOKENS_SPEC = P(('dp', 'fsdp'), None)
+DISPATCH_SPEC = P(('dp', 'fsdp'), 'ep', None)
+EXPERT_IN_SPEC = P('ep', None, None)
+
+
+from skypilot_tpu.parallel.mesh import shard as _shard  # noqa: E402
+
+
+def sparse_moe(x: jax.Array,
+               w_router: jax.Array,
+               w_gate: jax.Array,
+               w_up: jax.Array,
+               w_down: jax.Array,
+               cfg: MoEConfig,
+               rng: Optional[jax.Array] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU FFN. x [B, S, D]; w_router [D, E]; experts [E, D, F] /
+    [E, F, D]. Returns (out [B, S, D], weighted aux loss scalar).
+
+    `rng`, when given, adds Switch-style input jitter during training.
+    """
+    b, s, d = x.shape
+    x_flat = x.reshape(b * s, d)
+    x_flat = _shard(x_flat, TOKENS_SPEC)
+
+    router_in = x_flat.astype(jnp.float32)
+    if rng is not None:
+        router_in = router_in * jax.random.uniform(
+            rng, router_in.shape, minval=0.98, maxval=1.02)
+    router_logits = router_in @ w_router.astype(jnp.float32)   # [T, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+
+    capacity = expert_capacity(cfg, b * s)
+    dispatch, combine, assigned = _top_k_dispatch(probs, cfg, capacity)
+    dispatch = _shard(dispatch, DISPATCH_SPEC)
+    combine = _shard(combine, DISPATCH_SPEC)
+
+    # Dispatch: [T, D] x [T, E, C] -> [E, C, D]; all-to-all over 'ep'.
+    xs = jnp.einsum('td,tec->ecd', x_flat.astype(w_gate.dtype),
+                    dispatch.astype(w_gate.dtype))
+    xs = _shard(xs, EXPERT_IN_SPEC)
+    gate = jax.nn.silu(jnp.einsum('ecd,edf->ecf', xs, w_gate))
+    up = jnp.einsum('ecd,edf->ecf', xs, w_up)
+    out_e = jnp.einsum('ecf,efd->ecd', gate * up, w_down)      # [E, C, D]
+    out = jnp.einsum('ecd,tec->td', out_e,
+                     combine.astype(out_e.dtype))              # [T, D]
+    out = _shard(out, TOKENS_SPEC)
+
+    loss = aux_losses(probs, router_logits, assigned, cfg)
+    return out.reshape(b, s, d).astype(x.dtype), loss

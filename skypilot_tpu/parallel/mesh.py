@@ -13,7 +13,10 @@ Canonical axes (any may be size 1):
     'sp'    sequence/context parallel (ring attention; rides ICI neighbors)
     'tp'    tensor parallel (megatron-style; innermost, most
             communication-intensive -> fastest ICI axis)
-    'ep'    expert parallel (MoE); laid over the same physical axis as tp
+    'ep'    expert parallel (MoE all-to-all); sits between the data axes
+            and sp/tp in AXIS_ORDER — closer to the torus interior than
+            dp/fsdp, but outside the tp axis, which keeps the per-layer
+            tp reduces on the fastest links
 """
 from __future__ import annotations
 
@@ -24,7 +27,7 @@ import jax
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER = ('dp', 'fsdp', 'sp', 'tp')
+AXIS_ORDER = ('dp', 'fsdp', 'ep', 'sp', 'tp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,13 +37,14 @@ class MeshShape:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.fsdp * self.sp * self.tp * self.ep
 
     def as_tuple(self) -> Sequence[int]:
-        return (self.dp, self.fsdp, self.sp, self.tp)
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def make_mesh(shape: MeshShape,
@@ -64,16 +68,28 @@ def make_mesh(shape: MeshShape,
 
 
 def default_mesh_shape(num_devices: int,
-                       tp: int = 1, sp: int = 1,
+                       tp: int = 1, sp: int = 1, ep: int = 1,
                        dp: Optional[int] = None) -> MeshShape:
-    """FSDP-first default: everything not claimed by tp/sp/dp goes to fsdp
-    (the right default for 8B-class training on pods)."""
-    claimed = tp * sp * (dp or 1)
+    """FSDP-first default: everything not claimed by tp/sp/ep/dp goes to
+    fsdp (the right default for 8B-class training on pods)."""
+    claimed = tp * sp * ep * (dp or 1)
     if num_devices % claimed != 0:
         raise ValueError(
-            f'{num_devices} devices not divisible by tp*sp*dp={claimed}')
+            f'{num_devices} devices not divisible by '
+            f'tp*sp*ep*dp={claimed}')
     fsdp = num_devices // claimed
-    return MeshShape(dp=dp or 1, fsdp=fsdp, sp=sp, tp=tp)
+    return MeshShape(dp=dp or 1, fsdp=fsdp, sp=sp, tp=tp, ep=ep)
+
+
+def shard(x: jax.Array, spec) -> jax.Array:
+    """with_sharding_constraint if we're under a mesh; no-op otherwise.
+
+    The single home of this helper — model and op code imports it so the
+    no-mesh fallback behavior cannot drift between copies."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x
 
 
 def single_device_mesh() -> Mesh:

@@ -68,15 +68,19 @@ def default_optimizer(lr: float = 3e-4,
     )
 
 
-def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
-                    params_struct: Any, opt_state_struct: Any) -> TrainState:
+def state_shardings(cfg: Any, mesh: Mesh,
+                    params_struct: Any, opt_state_struct: Any,
+                    model: Any = llama) -> TrainState:
     """NamedShardings for the whole TrainState. Optimizer moments (mu/nu in
     adamw) are structural copies of the param tree, so each opt-state leaf
     inherits the spec of the param whose tree path its own path ends with
     (path-suffix match — NOT shape match: wq and wo are identically shaped
-    but transposed-sharded). Scalar leaves (step counts) replicate."""
+    but transposed-sharded). Scalar leaves (step counts) replicate.
+
+    `model` is any module exposing init_params/param_shardings/forward
+    (models/llama.py, models/mixtral.py, ...)."""
     del params_struct
-    pspecs = llama.param_shardings(cfg)
+    pspecs = model.param_shardings(cfg)
 
     def _path_key(path) -> tuple:
         out = []
@@ -106,21 +110,23 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
                                                    opt_state_struct))
 
 
-def init_train_state(cfg: llama.LlamaConfig, mesh: Mesh,
+def init_train_state(cfg: Any, mesh: Mesh,
                      optimizer: Optional[optax.GradientTransformation] = None,
-                     seed: int = 0
+                     seed: int = 0,
+                     model: Any = llama
                      ) -> Tuple[TrainState, TrainState, Any]:
     """Initialize params/opt-state directly sharded on the mesh (no host
     round-trip: jit with out_shardings materializes each shard on its
     device). Returns (state, shardings, optimizer)."""
     optimizer = optimizer or default_optimizer()
     params_struct = jax.eval_shape(
-        functools.partial(llama.init_params, cfg=cfg), jax.random.PRNGKey(0))
+        functools.partial(model.init_params, cfg=cfg), jax.random.PRNGKey(0))
     opt_struct = jax.eval_shape(optimizer.init, params_struct)
-    shardings = state_shardings(cfg, mesh, params_struct, opt_struct)
+    shardings = state_shardings(cfg, mesh, params_struct, opt_struct,
+                                model=model)
 
     def _init(key):
-        params = llama.init_params(key, cfg)
+        params = model.init_params(key, cfg)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=optimizer.init(params))
 
@@ -129,19 +135,29 @@ def init_train_state(cfg: llama.LlamaConfig, mesh: Mesh,
     return state, shardings, optimizer
 
 
-def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
+def make_train_step(cfg: Any, mesh: Mesh,
                     optimizer: optax.GradientTransformation,
-                    shardings: TrainState
+                    shardings: TrainState,
+                    model: Any = llama,
+                    loss_fn: Optional[Callable] = None
                     ) -> Callable[[TrainState, Dict[str, jax.Array]],
                                   Tuple[TrainState, Dict[str, jax.Array]]]:
     """Jitted SPMD train step. batch = {'tokens': [B, S+1] int32} (inputs
-    tokens[:, :-1], targets tokens[:, 1:]); donates state."""
+    tokens[:, :-1], targets tokens[:, 1:]); donates state.
+
+    `loss_fn(params, tokens) -> scalar` overrides the default next-token
+    CE; models with auxiliary losses expose `make_loss_fn(cfg)` (e.g.
+    mixtral's router load-balance loss) which is used automatically."""
     batch_sharding = NamedSharding(mesh, P(('dp', 'fsdp'), None))
 
-    def loss_fn(params, tokens):
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = llama.forward(params, inputs, cfg)
-        return cross_entropy_loss(logits, targets)
+    if loss_fn is None:
+        if hasattr(model, 'make_loss_fn'):
+            loss_fn = model.make_loss_fn(cfg)
+        else:
+            def loss_fn(params, tokens):
+                inputs, targets = tokens[:, :-1], tokens[:, 1:]
+                logits = model.forward(params, inputs, cfg)
+                return cross_entropy_loss(logits, targets)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
         loss, grads = jax.value_and_grad(loss_fn)(state.params,

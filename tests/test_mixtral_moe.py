@@ -1,0 +1,127 @@
+"""MoE op + Mixtral model tests on a virtual CPU mesh.
+
+Covers what the reference never could (its Mixtral support is a vLLM
+recipe YAML): routing correctness, expert-parallel sharding, and an
+end-to-end MoE train step.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama, mixtral
+from skypilot_tpu.ops import moe
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+def test_dispatch_routes_every_token_with_ample_capacity():
+    cfg = moe.MoEConfig(num_experts=4, top_k=2, capacity_factor=4.0)
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(0), (32, 4)), axis=-1)
+    capacity = moe.expert_capacity(cfg, 32)
+    dispatch, combine, assigned = moe._top_k_dispatch(probs, cfg, capacity)
+    # Pre-drop assignment counts: exactly top_k per token.
+    np.testing.assert_allclose(np.asarray(jnp.sum(assigned, axis=1)),
+                               np.full(32, 2.0))
+    # Every token occupies exactly top_k slots, each exactly once.
+    np.testing.assert_allclose(np.asarray(jnp.sum(dispatch, axis=(1, 2))),
+                               np.full(32, 2.0))
+    # Combine weights renormalize to 1 per token.
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                               np.ones(32), rtol=1e-5)
+    # No expert slot double-booked.
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0
+
+
+def test_capacity_drops_overflow_tokens():
+    cfg = moe.MoEConfig(num_experts=4, top_k=1, capacity_factor=1.0)
+    # All tokens want expert 0.
+    probs = jnp.tile(jnp.array([[0.97, 0.01, 0.01, 0.01]]), (64, 1))
+    capacity = moe.expert_capacity(cfg, 64)
+    dispatch, _, assigned = moe._top_k_dispatch(probs, cfg, capacity)
+    assert float(jnp.sum(dispatch)) == capacity  # the rest dropped
+    # Load-balance loss sees the pre-drop imbalance (all 64 on expert 0).
+    assert float(jnp.sum(assigned[:, 0])) == 64.0
+
+
+def test_moe_matches_dense_when_experts_identical():
+    """With identical experts and full capacity, top-2 routed output ==
+    the dense SwiGLU (gates sum to 1 and every token is kept)."""
+    d, f, e = 16, 32, 4
+    key = jax.random.PRNGKey(1)
+    ks = jax.random.split(key, 5)
+    x = jax.random.normal(ks[0], (2, 8, d), jnp.float32)
+    wg = jax.random.normal(ks[1], (d, f), jnp.float32) / np.sqrt(d)
+    wu = jax.random.normal(ks[2], (d, f), jnp.float32) / np.sqrt(d)
+    wd = jax.random.normal(ks[3], (f, d), jnp.float32) / np.sqrt(f)
+    router = jax.random.normal(ks[4], (d, e), jnp.float32)
+
+    cfg = moe.MoEConfig(num_experts=e, top_k=2, capacity_factor=8.0)
+    out, _ = moe.sparse_moe(
+        x, router,
+        jnp.tile(wg[None], (e, 1, 1)), jnp.tile(wu[None], (e, 1, 1)),
+        jnp.tile(wd[None], (e, 1, 1)), cfg)
+    dense = (jax.nn.silu(x @ wg) * (x @ wu)) @ wd
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_forward_shapes_and_aux():
+    cfg = mixtral.mixtral_tiny()
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits, aux = jax.jit(
+        lambda p, t: mixtral.forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(float(aux)) and float(aux) > 0.0
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_mixtral_param_count_properties():
+    cfg = mixtral.mixtral_8x7b()
+    assert 46e9 < cfg.num_params < 48e9          # ~46.7B total
+    assert 12e9 < cfg.num_active_params < 14e9   # ~12.9B active
+
+
+@pytest.mark.parametrize('shape', [
+    mesh_lib.MeshShape(ep=4, tp=2),
+    mesh_lib.MeshShape(dp=2, fsdp=2, ep=2),
+])
+def test_mixtral_train_step_expert_parallel(shape):
+    """Full train step with experts sharded over 'ep' on 8 CPU devices."""
+    import optax
+    from skypilot_tpu.train import trainer
+    mesh = mesh_lib.make_mesh(shape, devices=jax.devices()[:8])
+    cfg = mixtral.mixtral_tiny()
+    state, shardings, opt = trainer.init_train_state(
+        cfg, mesh, optimizer=optax.adam(1e-2), model=mixtral)
+    step = trainer.make_train_step(cfg, mesh, opt, shardings,
+                                   model=mixtral)
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 33), 0,
+                                cfg.vocab_size)
+    state, metrics = step(state, {'tokens': tokens})
+    first = float(metrics['loss'])
+    assert np.isfinite(first)
+    for _ in range(3):
+        state, metrics = step(state, {'tokens': tokens})
+    assert float(metrics['loss']) < first      # memorizes a fixed batch
+    # Expert weights really are sharded over ep.
+    w_gate = state.params['layers']['w_gate']
+    spec = w_gate.sharding.spec
+    assert 'ep' in str(spec)
+
+
+def test_llama_trainer_still_default():
+    """Generalized trainer keeps the Llama path working unchanged."""
+    from skypilot_tpu.train import trainer
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(fsdp=2, tp=2),
+                              devices=jax.devices()[:4])
+    cfg = llama.llama_tiny()
+    state, shardings, opt = trainer.init_train_state(cfg, mesh)
+    step = trainer.make_train_step(cfg, mesh, opt, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 33), 0,
+                                cfg.vocab_size)
+    _, metrics = step(state, {'tokens': tokens})
+    assert np.isfinite(float(metrics['loss']))
