@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from skypilot_tpu.parallel.mesh import shard as _shard
+
 Params = Dict[str, Any]
 
 
@@ -232,9 +234,6 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     x = x + (gate * up) @ layer_params['w_down']
     x = _shard(x, ACT_SPEC)
     return x, kv_out
-
-
-from skypilot_tpu.parallel.mesh import shard as _shard  # noqa: E402
 
 
 def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from skypilot_tpu.parallel.mesh import shard as _shard
+
 
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
@@ -97,7 +99,6 @@ DISPATCH_SPEC = P(('dp', 'fsdp'), 'ep', None)
 EXPERT_IN_SPEC = P('ep', None, None)
 
 
-from skypilot_tpu.parallel.mesh import shard as _shard  # noqa: E402
 
 
 def sparse_moe(x: jax.Array,

@@ -8,6 +8,9 @@ PartitionSpecs over them; XLA inserts the collectives (psum/all-gather/
 reduce-scatter over ICI, DCN across slices).
 
 Canonical axes (any may be size 1):
+    'pp'    pipeline parallel (outermost: stage handoff is one
+            nearest-neighbor ppermute per microbatch, the cheapest
+            traffic, so it is the axis to lay across slices/DCN)
     'dp'    pure data parallel (across slices -> rides DCN)
     'fsdp'  data parallel + param sharding (ZeRO-3 style; rides ICI)
     'sp'    sequence/context parallel (ring attention; rides ICI neighbors)
@@ -27,7 +30,7 @@ import jax
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
-AXIS_ORDER = ('dp', 'fsdp', 'ep', 'sp', 'tp')
+AXIS_ORDER = ('pp', 'dp', 'fsdp', 'ep', 'sp', 'tp')
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,18 +41,20 @@ class MeshShape:
     sp: int = 1
     tp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp * self.ep
+        return (self.dp * self.fsdp * self.sp * self.tp * self.ep
+                * self.pp)
 
     def as_tuple(self) -> Sequence[int]:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
 
 def make_mesh(shape: MeshShape,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build a Mesh with dp outermost and tp innermost.
+    """Build a Mesh with pp/dp outermost and tp innermost.
 
     `mesh_utils.create_device_mesh` maps the logical mesh onto the physical
     ICI torus so that the innermost (most chatty) axis lands on

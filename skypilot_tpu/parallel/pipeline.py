@@ -1,0 +1,219 @@
+"""GPipe-style pipeline parallelism over the 'pp' mesh axis.
+
+The reference has no pipeline parallelism anywhere (SURVEY.md §2.10 —
+absence grep-verified); its parallelism story ends at node-level gang
+scheduling. Here PP is a framework primitive, built the XLA way:
+
+  * layer weights are already STACKED on a leading [L, ...] axis
+    (models/llama.py), so "stage s owns layers [s*L/pp, (s+1)*L/pp)" is
+    nothing more than sharding that leading axis over 'pp' — no param
+    surgery, the same pytree works pipelined and non-pipelined.
+  * the schedule is a `lax.scan` over `n_micro + pp - 1` ticks inside one
+    `shard_map`: every tick each stage runs its local layer stack (itself
+    a `lax.scan`) and hands its activation to the next stage with a single
+    nearest-neighbor `ppermute`. Static shapes, no host control flow, and
+    autodiff through scan+ppermute gives the backward pipeline for free.
+  * fill/drain bubbles are the standard GPipe cost: pp/(n_micro+pp-1)
+    idle fraction — callers pick n_micro >= 4*pp to amortize.
+
+Composes with 'dp'/'fsdp' batch sharding (microbatches stay sharded over
+the data axes inside the shard_map). 'sp'/'tp' must be 1 on the pipelined
+path for now: inside shard_map those would need manual collectives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel.mesh import shard as _shard
+
+
+def _stage_specs(param_specs: Any) -> Any:
+    """Turn per-layer param specs P(None, ...) into P('pp', ...): the
+    stacked layer axis becomes the stage axis."""
+    return jax.tree.map(
+        lambda spec: P('pp', *spec[1:]), param_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def pipeline_apply(layer_fn: Callable[[jax.Array, Any], jax.Array],
+                   stacked_params: Any,
+                   x: jax.Array,
+                   mesh: Mesh,
+                   n_micro: int,
+                   layer_param_specs: Any,
+                   axis_name: str = 'pp') -> jax.Array:
+    """Run `layer_fn` over pp pipeline stages.
+
+    layer_fn(x_mb [mb, S, D], one_layer_params) -> x_mb; must be closed
+    over everything else (rope angles etc. — closures of traced values are
+    fine because shard_map treats them as replicated inputs).
+    stacked_params: pytree with leading layer axis [L, ...], L % pp == 0.
+    x: [B, S, D] with B % n_micro == 0.
+    layer_param_specs: per-layer PartitionSpecs P(None, ...) as in
+    models/llama.py param_shardings for the 'layers' subtree.
+    """
+    pp = mesh.shape[axis_name]
+    if mesh.shape['sp'] != 1 or mesh.shape['tp'] != 1:
+        raise ValueError(
+            "pipelined path requires sp=1 and tp=1 (manual collectives "
+            "inside shard_map are not implemented); got "
+            f"sp={mesh.shape['sp']} tp={mesh.shape['tp']}")
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % pp != 0:
+        raise ValueError(f'{n_layers} layers not divisible by pp={pp}')
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f'batch {b} not divisible by n_micro={n_micro}')
+    data_shards = mesh.shape['dp'] * mesh.shape['fsdp']
+    if (b // n_micro) % data_shards != 0:
+        raise ValueError(
+            f'microbatch size {b // n_micro} not divisible by '
+            f'dp*fsdp={data_shards}')
+
+    # [B, S, D] -> [n_micro, mb, S, D]; microbatch dim unsharded, batch
+    # stays on the data axes.
+    x_mb = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+    x_spec = P(None, ('dp', 'fsdp'), *([None] * (x.ndim - 1)))
+
+    param_specs = _stage_specs(layer_param_specs)
+
+    def stage_program(local_params, x_local):
+        """Runs on every pp rank. local_params: [L/pp, ...];
+        x_local: [n_micro, mb_local, S, D]."""
+        idx = jax.lax.axis_index(axis_name)
+
+        def run_stage(carry):
+            return jax.lax.scan(
+                lambda c, lp: (layer_fn(c, lp), None), carry,
+                local_params)[0]
+
+        zero = jnp.zeros_like(x_local[0])
+        n_ticks = n_micro + pp - 1
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            outputs, recv = carry
+            # Stage 0 ingests microbatch t (clamped during drain);
+            # others consume what arrived from the previous stage.
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_local, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            inp = jnp.where(idx == 0, fresh, recv)
+            out = run_stage(inp)
+            # Last stage completed microbatch t-(pp-1) this tick. Early
+            # garbage writes land on index 0 and are overwritten at
+            # t == pp-1 by the real first microbatch.
+            write_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, out, write_idx, 0)
+            recv = jax.lax.ppermute(out, axis_name, perm)
+            return (outputs, recv), None
+
+        (outputs, _), _ = jax.lax.scan(
+            tick, (jnp.zeros_like(x_local), zero), jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; broadcast them so the
+        # result is replicated over 'pp' (one psum of activations).
+        outputs = jnp.where(idx == pp - 1, outputs, 0)
+        return jax.lax.psum(outputs, axis_name)
+
+    out = _shard_map(
+        stage_program, mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False)(stacked_params, x_mb)
+    return out.reshape(b, *x.shape[1:])
+
+
+# Llama-on-a-pipeline: the model-facing wrapper ------------------------ #
+
+def param_shardings_pp(cfg: llama.LlamaConfig) -> Any:
+    """Llama param specs with the stacked layer axis sharded over 'pp'
+    (each stage holds its own layers' weights; embed/head replicated)."""
+    specs = llama.param_shardings(cfg)
+    specs['layers'] = _stage_specs(specs['layers'])
+    # fsdp/tp must be 1 on the pipelined path; drop those axes from the
+    # per-layer specs so the tree is honest about where bytes live.
+    specs['layers'] = jax.tree.map(
+        lambda s: P(s[0], *([None] * (len(s) - 1))), specs['layers'],
+        is_leaf=lambda x: isinstance(x, P))
+    specs['embed'] = P(None, None)
+    specs['lm_head'] = P(None, None)
+    return specs
+
+
+def forward_pp(params: llama.Params, tokens: jax.Array,
+               cfg: llama.LlamaConfig, mesh: Mesh,
+               n_micro: int) -> jax.Array:
+    """Pipelined Llama forward: embed -> pp-staged layer stack -> head."""
+    b, s = tokens.shape
+    positions = jnp.arange(s)
+    angles = llama.rope_frequencies(cfg, positions)
+    x = params['embed'][tokens].astype(cfg.dtype)
+    x = _shard(x, P(('dp', 'fsdp'), None, None))
+
+    layer_fn = functools.partial(_pp_layer, cfg, angles)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+
+    layer_specs = jax.tree.map(
+        lambda sp: P(None, *([None] * (len(sp) - 1))),
+        llama.param_shardings(cfg)['layers'],
+        is_leaf=lambda x: isinstance(x, P))
+    x = pipeline_apply(layer_fn, params['layers'], x, mesh, n_micro,
+                       layer_specs)
+
+    x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
+    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
+                        preferred_element_type=jnp.float32)
+    return logits
+
+
+def _pp_layer(cfg: llama.LlamaConfig, angles: jax.Array,
+              x: jax.Array, layer_params: llama.Params) -> jax.Array:
+    x, _ = llama._layer(cfg, x, layer_params, angles)
+    return x
+
+
+def _default_n_micro(mesh: Mesh) -> int:
+    """4 microbatches per stage keeps the fill/drain bubble under 20%."""
+    return 4 * mesh.shape['pp']
+
+
+def make_loss_fn(cfg: llama.LlamaConfig, mesh: Mesh,
+                 n_micro: Optional[int] = None):
+    """Trainer-compatible loss over the pipelined forward."""
+    from skypilot_tpu.train import trainer
+    n_micro = n_micro or _default_n_micro(mesh)
+
+    def loss_fn(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = forward_pp(params, inputs, cfg, mesh, n_micro)
+        return trainer.cross_entropy_loss(logits, targets)
+    return loss_fn
+
+
+def trainer_model(mesh: Mesh, n_micro: Optional[int] = None):
+    """A model-module adapter so train/trainer.py drives the pipelined
+    Llama unchanged: same params as models/llama.py, stage-sharded specs,
+    pipelined loss."""
+    import types
+    return types.SimpleNamespace(
+        init_params=llama.init_params,
+        param_shardings=param_shardings_pp,
+        forward=lambda params, tokens, cfg: forward_pp(
+            params, tokens, cfg, mesh, n_micro or _default_n_micro(mesh)),
+        make_loss_fn=lambda cfg: make_loss_fn(cfg, mesh, n_micro),
+    )
