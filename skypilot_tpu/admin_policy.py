@@ -1,0 +1,90 @@
+"""Pluggable org-level admin policy applied to every launch.
+
+Reference: sky/admin_policy.py (AdminPolicy/UserRequest/MutatedUserRequest,
+:30,55,61) + sky/utils/admin_policy_utils.py (apply hook). An org points
+the config key `admin_policy: my_module.MyPolicy` at a class; every
+launch/exec/jobs/serve request passes through
+`validate_and_mutate(UserRequest) -> MutatedUserRequest` before the
+optimizer runs — the hook that lets platform teams enforce "spot only",
+"max v5p-128", "always label team=...", or reject outright.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOptions:
+    """Client-side context for the request (reference: admin_policy.py:38)."""
+    cluster_name: Optional[str] = None
+    idle_minutes_to_autostop: Optional[int] = None
+    down: bool = False
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the user asked for: the task plus client context.
+
+    `skypilot_config` in the reference carries the whole config dict so
+    policies can also rewrite config; we pass the loaded config dict."""
+    task: Any
+    request_options: RequestOptions
+    config: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: Any
+    config: dict = dataclasses.field(default_factory=dict)
+
+
+class AdminPolicy:
+    """Subclass and implement validate_and_mutate; raise
+    exceptions.AdminPolicyRejected to veto a request."""
+
+    @classmethod
+    def validate_and_mutate(cls,
+                            user_request: UserRequest) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+def _load_policy_class(path: str):
+    module_path, _, class_name = path.rpartition('.')
+    if not module_path:
+        raise exceptions.InvalidConfigError(
+            f'admin_policy must be "module.Class", got {path!r}')
+    try:
+        module = importlib.import_module(module_path)
+        return getattr(module, class_name)
+    except (ImportError, AttributeError) as e:
+        raise exceptions.InvalidConfigError(
+            f'Cannot import admin policy {path!r}: {e}') from e
+
+
+def apply(task: Any,
+          request_options: Optional[RequestOptions] = None) -> Any:
+    """Run the configured policy over the task; identity if none set.
+
+    Called from execution._execute before OPTIMIZE (reference applies at
+    sky/execution.py:172)."""
+    policy_path = config_lib.get_nested(['admin_policy'])
+    if not policy_path:
+        return task
+    policy = _load_policy_class(policy_path)
+    request = UserRequest(task=task,
+                          request_options=request_options
+                          or RequestOptions(),
+                          config=config_lib.get_nested([], default={}) or {})
+    mutated = policy.validate_and_mutate(request)
+    logger.debug(f'admin policy {policy_path} applied to task '
+                 f'{getattr(task, "name", None)!r}')
+    return mutated.task

@@ -1,0 +1,9 @@
+"""Benchmark subsystem (reference: sky/benchmark/ — fan-out candidate
+launches + sec/step & $/step reporting from step-callback logs)."""
+from skypilot_tpu.benchmark.utils import (delete_benchmark,
+                                          format_report, launch_benchmark,
+                                          teardown_benchmark,
+                                          update_benchmark)
+
+__all__ = ['launch_benchmark', 'update_benchmark', 'format_report',
+           'teardown_benchmark', 'delete_benchmark']

@@ -1,0 +1,115 @@
+"""Benchmark state DB (reference: sky/benchmark/benchmark_state.py —
+SQLite at ~/.sky/benchmark.db with benchmark + benchmark_results tables)."""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _db_path() -> str:
+    home = os.path.expanduser(os.environ.get('SKYT_HOME', '~/.skyt'))
+    os.makedirs(home, exist_ok=True)
+    return os.path.join(home, 'benchmark.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path())
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS benchmark (
+            name TEXT PRIMARY KEY,
+            task_name TEXT,
+            launched_at REAL)""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS benchmark_results (
+            benchmark TEXT,
+            cluster TEXT,
+            resources TEXT,
+            hourly_price REAL,
+            status TEXT,
+            job_id INTEGER,
+            num_steps INTEGER DEFAULT 0,
+            seconds_per_step REAL,
+            cost_per_step REAL,
+            total_steps INTEGER,
+            start_ts REAL,
+            last_ts REAL,
+            PRIMARY KEY (benchmark, cluster))""")
+    return conn
+
+
+def add_benchmark(name: str, task_name: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark VALUES (?, ?, ?)',
+            (name, task_name, time.time()))
+
+
+def add_result(benchmark: str, cluster: str, resources: str,
+               hourly_price: Optional[float], status: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'INSERT OR REPLACE INTO benchmark_results '
+            '(benchmark, cluster, resources, hourly_price, status) '
+            'VALUES (?, ?, ?, ?, ?)',
+            (benchmark, cluster, resources, hourly_price, status))
+
+
+def update_result(benchmark: str, cluster: str, *,
+                  status: Optional[str] = None,
+                  job_id: Optional[int] = None,
+                  num_steps: Optional[int] = None,
+                  seconds_per_step: Optional[float] = None,
+                  cost_per_step: Optional[float] = None,
+                  total_steps: Optional[int] = None,
+                  start_ts: Optional[float] = None,
+                  last_ts: Optional[float] = None) -> None:
+    sets, vals = [], []
+    for col, val in [('status', status), ('job_id', job_id),
+                     ('num_steps', num_steps),
+                     ('seconds_per_step', seconds_per_step),
+                     ('cost_per_step', cost_per_step),
+                     ('total_steps', total_steps),
+                     ('start_ts', start_ts), ('last_ts', last_ts)]:
+        if val is not None:
+            sets.append(f'{col} = ?')
+            vals.append(val)
+    if not sets:
+        return
+    with _conn() as conn:
+        conn.execute(
+            f'UPDATE benchmark_results SET {", ".join(sets)} '
+            'WHERE benchmark = ? AND cluster = ?',
+            (*vals, benchmark, cluster))
+
+
+def get_benchmarks() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute('SELECT name, task_name, launched_at '
+                            'FROM benchmark').fetchall()
+    return [{'name': r[0], 'task_name': r[1], 'launched_at': r[2]}
+            for r in rows]
+
+
+def get_results(benchmark: str) -> List[Dict[str, Any]]:
+    cols = ['benchmark', 'cluster', 'resources', 'hourly_price', 'status',
+            'job_id', 'num_steps', 'seconds_per_step', 'cost_per_step',
+            'total_steps', 'start_ts', 'last_ts']
+    with _conn() as conn:
+        rows = conn.execute(
+            f'SELECT {", ".join(cols)} FROM benchmark_results '
+            'WHERE benchmark = ?', (benchmark,)).fetchall()
+    return [dict(zip(cols, r)) for r in rows]
+
+
+def delete_benchmark(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM benchmark WHERE name = ?', (name,))
+        conn.execute('DELETE FROM benchmark_results WHERE benchmark = ?',
+                     (name,))
+
+
+def dumps_resources(overrides: Dict[str, Any]) -> str:
+    return json.dumps(overrides, sort_keys=True)

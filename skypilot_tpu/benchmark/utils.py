@@ -1,0 +1,202 @@
+"""Benchmark orchestration: fan-out launch, step-log harvest, report.
+
+Reference: sky/benchmark/benchmark_utils.py (892 LoC) — `sky bench
+launch` generates one candidate config per resource option, launches all
+in parallel, and `sky bench show` pulls the callback's timestamped step
+logs to compute sec/step and $/step (the BERT table in
+docs/source/reference/benchmark/index.rst is its output). Differences
+here: candidates are TPU topologies (v5e-8 vs v6e-8 vs v4-8 ...), logs
+come back over the CommandRunner (rsync) instead of a cloud bucket, and
+$/step uses the catalog's TPU pricing directly.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import callbacks
+from skypilot_tpu import exceptions
+from skypilot_tpu import execution
+from skypilot_tpu import global_user_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backend import CloudTpuBackend
+from skypilot_tpu.benchmark import state
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_REMOTE_LOG_DIR = '~/.skyt/benchmark_logs'
+
+
+def cluster_name(benchmark: str, idx: int) -> str:
+    return f'skyt-bench-{benchmark}-{idx}'
+
+
+def launch_benchmark(task: task_lib.Task,
+                     benchmark: str,
+                     candidates: List[Dict[str, Any]],
+                     parallel: int = 4) -> List[str]:
+    """Launch one cluster per candidate resource override, in parallel.
+
+    candidates: list of Resources.copy(**overrides) dicts, e.g.
+    [{'accelerators': 'tpu-v5e-8'}, {'accelerators': 'tpu-v6e-8'}].
+    Returns the launched cluster names. Each job gets
+    SKYT_BENCHMARK_LOG_DIR pointed at a per-benchmark path that
+    `update_benchmark` later pulls.
+    """
+    state.add_benchmark(benchmark, task.name or '-')
+    names = []
+
+    def _launch_one(args):
+        idx, overrides = args
+        name = cluster_name(benchmark, idx)
+        res = task.resources.copy(**overrides)
+        bench_task = task_lib.Task(
+            name=f'{task.name or "bench"}-{idx}',
+            run=task.run, setup=task.setup, num_nodes=task.num_nodes,
+            workdir=task.workdir, file_mounts=task.file_mounts,
+            envs={**(task.envs or {}),
+                  callbacks.ENV_LOG_DIR: f'{_REMOTE_LOG_DIR}/{benchmark}'})
+        bench_task.set_resources(res)
+        state.add_result(benchmark, name, str(res), res.hourly_price(),
+                         'LAUNCHING')
+        try:
+            job_id, _ = execution.launch(bench_task, cluster_name=name,
+                                         detach_run=True,
+                                         quiet_optimizer=True)
+            state.update_result(benchmark, name, status='RUNNING',
+                                job_id=job_id)
+        except Exception as e:  # noqa: BLE001 — candidate may be infeasible
+            logger.warning(f'benchmark candidate {name} failed: {e}')
+            state.update_result(benchmark, name, status='FAILED')
+        return name
+
+    names = subprocess_utils.run_in_parallel(
+        _launch_one, list(enumerate(candidates)), parallel)
+    return list(names)
+
+
+def _parse_step_logs(local_dir: str) -> Optional[Dict[str, Any]]:
+    ts_path = os.path.join(local_dir, 'timestamps.jsonl')
+    if not os.path.exists(ts_path):
+        return None
+    steps = []
+    with open(ts_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                steps.append(json.loads(line))
+    if len(steps) < 2:
+        return None
+    cfg_path = os.path.join(local_dir, 'config.json')
+    total = None
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            total = json.load(f).get('total_steps')
+    # First interval includes jit compile; drop it when we can afford to
+    # (reference discards warmup the same way via its boot timestamp).
+    ts = [s['ts'] for s in steps]
+    intervals = [b - a for a, b in zip(ts, ts[1:])]
+    if len(intervals) > 2:
+        intervals = intervals[1:]
+    sec_per_step = sum(intervals) / len(intervals)
+    return {'num_steps': len(steps), 'seconds_per_step': sec_per_step,
+            'total_steps': total, 'start_ts': ts[0], 'last_ts': ts[-1]}
+
+
+def update_benchmark(benchmark: str) -> List[Dict[str, Any]]:
+    """Pull step logs from every candidate cluster (in parallel) and
+    recompute sec/step + $/step. Returns the refreshed result rows."""
+    backend = CloudTpuBackend()
+
+    def _refresh(row):
+        record = global_user_state.get_cluster(row['cluster'])
+        if record is None or record['handle'] is None:
+            if row['status'] not in ('FAILED', 'TERMINATED'):
+                state.update_result(benchmark, row['cluster'],
+                                    status='TERMINATED')
+            return
+        handle = record['handle']
+        local_dir = os.path.join(tempfile.mkdtemp(prefix='skyt-bench-'),
+                                 row['cluster'])
+        try:
+            handle.head_runner().rsync(f'{_REMOTE_LOG_DIR}/{benchmark}/',
+                                       local_dir, up=False, check=False)
+        except Exception as e:  # noqa: BLE001
+            logger.debug(f'log pull failed for {row["cluster"]}: {e}')
+            return
+        parsed = _parse_step_logs(local_dir)
+        if parsed is None:
+            return
+        price = row['hourly_price']
+        cost = (price / 3600.0 * parsed['seconds_per_step']
+                if price else None)
+        status = row['status']
+        job_status = backend.get_job_status(handle, row['job_id'] or 1)
+        if job_status in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+            status = 'FINISHED' if job_status == 'SUCCEEDED' else job_status
+        state.update_result(
+            benchmark, row['cluster'], status=status,
+            num_steps=parsed['num_steps'],
+            seconds_per_step=parsed['seconds_per_step'],
+            cost_per_step=cost, total_steps=parsed['total_steps'],
+            start_ts=parsed['start_ts'], last_ts=parsed['last_ts'])
+
+    subprocess_utils.run_in_parallel(_refresh, state.get_results(benchmark),
+                                     8)
+    return state.get_results(benchmark)
+
+
+def format_report(benchmark: str) -> str:
+    rows = state.get_results(benchmark)
+    header = ['CLUSTER', 'RESOURCES', 'STATUS', 'STEPS', 'SEC/STEP',
+              '$/STEP', '$/HR']
+    lines = ['  '.join(f'{h:<18}' for h in header)]
+    for r in rows:
+        sps = (f"{r['seconds_per_step']:.4f}"
+               if r['seconds_per_step'] else '-')
+        cps = f"{r['cost_per_step']:.6f}" if r['cost_per_step'] else '-'
+        price = f"{r['hourly_price']:.2f}" if r['hourly_price'] else '-'
+        cells = [r['cluster'], r['resources'][:18], r['status'] or '-',
+                 str(r['num_steps'] or 0), sps, cps, price]
+        lines.append('  '.join(f'{c:<18}' for c in cells))
+    return '\n'.join(lines)
+
+
+def teardown_benchmark(benchmark: str) -> None:
+    """`sky bench down`: terminate every candidate cluster."""
+    backend = CloudTpuBackend()
+
+    def _down(row):
+        record = global_user_state.get_cluster(row['cluster'])
+        if record is not None and record['handle'] is not None:
+            try:
+                backend.teardown(record['handle'])
+            except Exception as e:  # noqa: BLE001
+                # Leave the row as-is: a cluster we failed to tear down
+                # is still running (and billing) — hiding it behind
+                # TERMINATED would orphan it.
+                logger.warning(f'teardown {row["cluster"]} failed, '
+                               f'still tracked: {e}')
+                return
+        state.update_result(benchmark, row['cluster'],
+                            status='TERMINATED')
+
+    subprocess_utils.run_in_parallel(_down, state.get_results(benchmark), 4)
+
+
+def delete_benchmark(benchmark: str, force: bool = False) -> None:
+    """Drop tracking rows. Refuses while candidate clusters may still be
+    running (they would become undiscoverable) unless force=True."""
+    live = [r['cluster'] for r in state.get_results(benchmark)
+            if r['status'] not in ('TERMINATED', 'FAILED', None)]
+    if live and not force:
+        raise exceptions.NotSupportedError(
+            f'Benchmark {benchmark!r} has non-terminated clusters '
+            f'({", ".join(live)}); run `skyt bench down {benchmark}` '
+            'first or pass --force.')
+    state.delete_benchmark(benchmark)

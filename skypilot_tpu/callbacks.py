@@ -1,0 +1,97 @@
+"""Step-timestamp callback for benchmarked training jobs.
+
+Reference: the separate `sky_callback` package (sky/callbacks/,
+sky_callback.init/step + Keras/Lightning/Transformers adapters) whose
+timestamped step logs the benchmark subsystem turns into sec/step and
+$/step. Here it is one dependency-free module shipped inside the
+framework wheel, plus a JAX-first convenience (`wrap_step`) instead of
+torch-framework adapters.
+
+Protocol (what benchmark/utils.py parses):
+    <log_dir>/config.json     {"total_steps": N | null, "start_ts": ...}
+    <log_dir>/timestamps.jsonl  one {"step": i, "ts": float} line per step
+
+Only global rank 0 writes (every TPU host runs the same SPMD program;
+writing once is enough and avoids N-host merge).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import time
+from typing import Any, Callable, Optional
+
+ENV_LOG_DIR = 'SKYT_BENCHMARK_LOG_DIR'
+DEFAULT_LOG_DIR = '~/.skyt/benchmark_logs/default'
+
+_state: dict = {'fh': None, 'step': 0}
+
+
+def _is_rank_zero() -> bool:
+    return os.environ.get('SKYT_PROCESS_ID', '0') == '0'
+
+
+def init(log_dir: Optional[str] = None,
+         total_steps: Optional[int] = None) -> None:
+    """Open the step log. Call once before the train loop."""
+    if not _is_rank_zero():
+        return
+    log_dir = os.path.expanduser(
+        log_dir or os.environ.get(ENV_LOG_DIR, DEFAULT_LOG_DIR))
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, 'config.json'), 'w') as f:
+        json.dump({'total_steps': total_steps, 'start_ts': time.time()}, f)
+    # 'w' (not append): a rerun on a reused cluster must not mix two
+    # runs' timestamps — the inter-run gap would corrupt sec/step.
+    _state['fh'] = open(os.path.join(log_dir, 'timestamps.jsonl'), 'w',
+                        buffering=1)   # line-buffered: tail-able live
+    _state['step'] = 0
+
+
+def on_step_end(step: Optional[int] = None) -> None:
+    """Record one finished step (monotonic default numbering)."""
+    fh = _state.get('fh')
+    if fh is None:
+        return
+    if step is None:
+        step = _state['step']
+    _state['step'] = step + 1
+    fh.write(json.dumps({'step': step, 'ts': time.time()}) + '\n')
+
+
+@contextlib.contextmanager
+def step():
+    """`with sky_callback.step():` around each training step."""
+    try:
+        yield
+    finally:
+        on_step_end()
+
+
+def wrap_step(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Wrap a (jitted) train-step callable so every call logs a step.
+
+    Blocks on the result's readiness before stamping (jax dispatch is
+    async — without `block_until_ready` the timestamps would measure
+    dispatch, not compute)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        try:
+            import jax
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — non-jax return values
+            pass
+        on_step_end()
+        return out
+    return wrapped
+
+
+def close() -> None:
+    fh = _state.get('fh')
+    if fh is not None:
+        fh.close()
+        _state['fh'] = None

@@ -378,6 +378,76 @@ def serve_down(service_name, yes):
 
 
 @cli.group()
+def bench():
+    """Benchmark a task across candidate TPU types (reference: sky bench)."""
+
+
+@bench.command(name='launch')
+@click.argument('entrypoint')
+@click.option('--benchmark', '-b', 'bench_name', required=True,
+              help='Benchmark name.')
+@click.option('--gpus', '--accelerators', 'accelerators', multiple=True,
+              required=True,
+              help='Candidate TPU types, e.g. -b x --gpus tpu-v5e-8 '
+                   '--gpus tpu-v4-8.')
+@click.option('--env', multiple=True)
+@click.option('--yes', '-y', is_flag=True)
+def bench_launch(entrypoint, bench_name, accelerators, env, yes):
+    from skypilot_tpu.benchmark import utils as bench_utils
+    task = _load_task(entrypoint, env, {})
+    candidates = [{'tpu': acc} for acc in accelerators]
+    if not yes:
+        click.confirm(
+            f'Launch {len(candidates)} benchmark clusters?', abort=True,
+            default=True)
+    names = bench_utils.launch_benchmark(task, bench_name, candidates)
+    print(f'Benchmark {bench_name!r}: launched {len(names)} candidates.')
+    print(f'Watch with: skyt bench show {bench_name}')
+
+
+@bench.command(name='show')
+@click.argument('benchmark')
+def bench_show(benchmark):
+    from skypilot_tpu.benchmark import utils as bench_utils
+    bench_utils.update_benchmark(benchmark)
+    print(bench_utils.format_report(benchmark))
+
+
+@bench.command(name='ls')
+def bench_ls():
+    from skypilot_tpu.benchmark import state as bench_state
+    rows = [[b['name'], b['task_name'], _fmt_age(b['launched_at'])]
+            for b in bench_state.get_benchmarks()]
+    print(_table(['BENCHMARK', 'TASK', 'AGE'], rows))
+
+
+@bench.command(name='down')
+@click.argument('benchmark')
+@click.option('--yes', '-y', is_flag=True)
+def bench_down(benchmark, yes):
+    from skypilot_tpu.benchmark import utils as bench_utils
+    if not yes:
+        click.confirm(f'Tear down benchmark {benchmark!r} clusters?',
+                      abort=True)
+    bench_utils.teardown_benchmark(benchmark)
+    print(f'Benchmark {benchmark!r} clusters terminated.')
+
+
+@bench.command(name='delete')
+@click.argument('benchmark')
+@click.option('--force', is_flag=True,
+              help='Delete tracking even if clusters are still up.')
+@click.option('--yes', '-y', is_flag=True)
+def bench_delete(benchmark, force, yes):
+    from skypilot_tpu.benchmark import utils as bench_utils
+    if not yes:
+        click.confirm(f'Delete benchmark {benchmark!r} records?',
+                      abort=True)
+    bench_utils.delete_benchmark(benchmark, force=force)
+    print(f'Benchmark {benchmark!r} deleted.')
+
+
+@cli.group()
 def storage():
     """Bucket lifecycle."""
 

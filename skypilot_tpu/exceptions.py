@@ -134,3 +134,11 @@ class ManagedJobReachedMaxRetriesError(SkyTpuError):
 
 class NoCloudAccessError(SkyTpuError):
     """No cloud credentials found for any enabled cloud."""
+
+
+class InvalidConfigError(SkyTpuError):
+    """Malformed ~/.skyt/config.yaml entry (bad admin_policy path etc.)."""
+
+
+class AdminPolicyRejected(SkyTpuError):
+    """The configured org admin policy vetoed this request."""

@@ -18,6 +18,7 @@ from skypilot_tpu import optimizer as optimizer_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backend import CloudTpuBackend, ClusterHandle
+from skypilot_tpu.usage import usage_lib
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
@@ -58,6 +59,13 @@ def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
         raise exceptions.NotSupportedError(
             'launch/exec take a single task; use managed jobs for chains.')
     task = dag.tasks[0]
+
+    # Org admin policy hook (reference applies at execution.py:172).
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(
+        task, admin_policy.RequestOptions(cluster_name=cluster_name,
+                                          down=down, dryrun=dryrun))
+
     if cluster_name is None:
         cluster_name = _generate_cluster_name()
 
@@ -121,6 +129,7 @@ def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
     return job_id, handle
 
 
+@usage_lib.entrypoint
 def launch(task: Union[task_lib.Task, dag_lib.Dag],
            cluster_name: Optional[str] = None,
            dryrun: bool = False,
@@ -145,6 +154,7 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
                     avoid_zones=avoid_zones)
 
 
+@usage_lib.entrypoint
 def exec(task: Union[task_lib.Task, dag_lib.Dag],  # pylint: disable=redefined-builtin
          cluster_name: str,
          detach_run: bool = False

@@ -1,0 +1,130 @@
+"""Usage telemetry (reference: sky/usage/usage_lib.py — message schema +
+POST to a self-hosted Loki, `@entrypoint` wrapping every public API, with
+privacy env knobs).
+
+Differences from the reference, deliberate:
+  * default is a local JSONL spool under ~/.skyt/usage/ — nothing leaves
+    the machine unless SKYT_USAGE_ENDPOINT is explicitly configured
+    (reference POSTs to its hosted Loki by default; we invert that).
+  * schema keeps the same shape (run id, client version, entrypoint,
+    duration, exception type) so an org can point the endpoint at the
+    same Grafana/Loki stack the reference documents
+    (sky/design_docs/usage_collection.md).
+
+Knobs: SKYT_DISABLE_USAGE_COLLECTION=1 (same spelling as the reference's
+SKYPILOT_DISABLE_USAGE_COLLECTION) disables everything.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import os
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Optional
+
+_run_id: Optional[str] = None
+
+ENV_DISABLE = 'SKYT_DISABLE_USAGE_COLLECTION'
+ENV_ENDPOINT = 'SKYT_USAGE_ENDPOINT'
+
+
+def disabled() -> bool:
+    return os.environ.get(ENV_DISABLE, '0') == '1'
+
+
+def run_id() -> str:
+    global _run_id
+    if _run_id is None:
+        _run_id = str(uuid.uuid4())
+    return _run_id
+
+
+def _spool_path() -> str:
+    home = os.path.expanduser(os.environ.get('SKYT_HOME', '~/.skyt'))
+    d = os.path.join(home, 'usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'usage.jsonl')
+
+
+def _client_version() -> str:
+    try:
+        from skypilot_tpu import __version__
+        return __version__
+    except ImportError:
+        return 'unknown'
+
+
+def _emit(message: dict) -> None:
+    """Spool locally; POST only if an endpoint is explicitly set."""
+    if disabled():
+        return
+    try:
+        with open(_spool_path(), 'a') as f:
+            f.write(json.dumps(message) + '\n')
+    except OSError:
+        return
+    endpoint = os.environ.get(ENV_ENDPOINT)
+    if not endpoint:
+        return
+    try:  # Loki push-API shape, like the reference's Grafana stack.
+        import urllib.request
+        payload = json.dumps({
+            'streams': [{
+                'stream': {'job': 'skyt-usage'},
+                'values': [[str(int(message['ts'] * 1e9)),
+                            json.dumps(message)]],
+            }]
+        }).encode()
+        req = urllib.request.Request(
+            endpoint, data=payload,
+            headers={'Content-Type': 'application/json'})
+        urllib.request.urlopen(req, timeout=2)
+    except Exception:  # noqa: BLE001 — telemetry must never break the CLI
+        pass
+
+
+def record(event: str, **fields: Any) -> None:
+    _emit({'ts': time.time(), 'run_id': run_id(), 'event': event,
+           'client_version': _client_version(), **fields})
+
+
+def entrypoint(fn: Callable) -> Callable:
+    """Wrap a public API function: one usage message per call with
+    duration and exception type (reference: @usage_lib.entrypoint)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if disabled():
+            return fn(*args, **kwargs)
+        start = time.time()
+        exc_name = None
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            exc_name = type(e).__name__
+            raise
+        finally:
+            record('api_call',
+                   entrypoint=f'{fn.__module__}.{fn.__qualname__}',
+                   duration_s=round(time.time() - start, 3),
+                   exception=exc_name,
+                   stacktrace_hash=(hashlib.sha256(
+                       traceback.format_exc().encode()).hexdigest()[:16]
+                       if exc_name else None))
+    return wrapped
+
+
+def read_spool() -> list:
+    """All locally spooled usage messages (for tests / inspection)."""
+    path = _spool_path()
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line))
+    return out

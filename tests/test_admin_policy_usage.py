@@ -1,0 +1,121 @@
+"""Admin policy hook + usage telemetry tests.
+
+Reference: sky/admin_policy.py + tests of admin_policy_utils; usage_lib
+@entrypoint wrapping (sky/usage/usage_lib.py).
+"""
+import os
+
+import pytest
+import yaml
+
+import skypilot_tpu as sky
+from skypilot_tpu import admin_policy, config, exceptions
+from skypilot_tpu.usage import usage_lib
+
+
+# Policies importable by path for _load_policy_class.
+class ForceSpotPolicy(admin_policy.AdminPolicy):
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        task = user_request.task
+        task.set_resources(task.resources.copy(use_spot=True))
+        return admin_policy.MutatedUserRequest(task=task)
+
+
+class RejectAllPolicy(admin_policy.AdminPolicy):
+    @classmethod
+    def validate_and_mutate(cls, user_request):
+        raise exceptions.AdminPolicyRejected('nope')
+
+
+def _write_config(tmp_path, monkeypatch, policy_path):
+    del tmp_path, monkeypatch  # config lives under the hermetic SKYT_HOME
+    home = os.path.expanduser(os.environ['SKYT_HOME'])
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, 'config.yaml'), 'w') as f:
+        yaml.dump({'admin_policy': policy_path}, f)
+    config.reload()
+
+
+def _task():
+    t = sky.Task(name='t', run='true')
+    t.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                      cloud='fake'))
+    return t
+
+
+def test_no_policy_is_identity():
+    t = _task()
+    assert admin_policy.apply(t) is t
+
+
+def test_policy_mutates_request(tmp_path, monkeypatch):
+    _write_config(tmp_path, monkeypatch,
+                  f'{__name__}.ForceSpotPolicy')
+    t = _task()
+    assert not t.resources.use_spot
+    mutated = admin_policy.apply(t)
+    assert mutated.resources.use_spot
+
+
+def test_policy_rejects_launch(tmp_path, monkeypatch):
+    _write_config(tmp_path, monkeypatch, f'{__name__}.RejectAllPolicy')
+    with pytest.raises(exceptions.AdminPolicyRejected):
+        sky.launch(_task(), cluster_name='rejected', dryrun=True)
+
+
+def test_bad_policy_path_raises(tmp_path, monkeypatch):
+    _write_config(tmp_path, monkeypatch, 'not_a_module.Nope')
+    with pytest.raises(exceptions.InvalidConfigError):
+        admin_policy.apply(_task())
+
+
+def test_policy_applies_through_launch(tmp_path, monkeypatch):
+    """Full launch on the fake cloud comes out spot-mutated."""
+    _write_config(tmp_path, monkeypatch, f'{__name__}.ForceSpotPolicy')
+    from skypilot_tpu import global_user_state
+    job_id, handle = sky.launch(_task(), cluster_name='pol1',
+                                quiet_optimizer=True)
+    record = global_user_state.get_cluster('pol1')
+    assert record['handle'].launched_resources.use_spot
+
+
+def test_usage_entrypoint_spools(monkeypatch):
+    monkeypatch.delenv(usage_lib.ENV_DISABLE, raising=False)
+
+    @usage_lib.entrypoint
+    def fn(x):
+        return x * 2
+
+    assert fn(21) == 42
+    msgs = [m for m in usage_lib.read_spool() if m['event'] == 'api_call']
+    assert msgs, 'no usage messages spooled'
+    last = msgs[-1]
+    assert last['entrypoint'].endswith('fn')
+    assert last['exception'] is None
+    assert 'duration_s' in last and 'run_id' in last
+
+
+def test_usage_records_exceptions(monkeypatch):
+    monkeypatch.delenv(usage_lib.ENV_DISABLE, raising=False)
+
+    @usage_lib.entrypoint
+    def boom():
+        raise ValueError('x')
+
+    with pytest.raises(ValueError):
+        boom()
+    last = [m for m in usage_lib.read_spool()
+            if m['event'] == 'api_call'][-1]
+    assert last['exception'] == 'ValueError'
+
+
+def test_usage_disable_knob(monkeypatch):
+    monkeypatch.setenv(usage_lib.ENV_DISABLE, '1')
+
+    @usage_lib.entrypoint
+    def fn():
+        return 1
+
+    fn()
+    assert usage_lib.read_spool() == []

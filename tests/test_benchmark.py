@@ -1,0 +1,104 @@
+"""Benchmark subsystem e2e on the fake cloud + callback unit tests.
+
+Reference behavior being reproduced: sky bench launch fans out candidate
+clusters, the sky_callback step log is harvested into sec/step + $/step
+(sky/benchmark/benchmark_utils.py:432,488,584).
+"""
+import json
+import os
+import time
+
+import skypilot_tpu as sky
+from skypilot_tpu import callbacks, core
+from skypilot_tpu.benchmark import state as bench_state
+from skypilot_tpu.benchmark import utils as bench_utils
+
+
+def test_callback_writes_protocol(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYT_PROCESS_ID', '0')
+    log_dir = str(tmp_path / 'bench')
+    callbacks.init(log_dir=log_dir, total_steps=5)
+    for _ in range(5):
+        with callbacks.step():
+            pass
+    callbacks.close()
+    cfg = json.load(open(os.path.join(log_dir, 'config.json')))
+    assert cfg['total_steps'] == 5
+    lines = open(os.path.join(log_dir, 'timestamps.jsonl')).readlines()
+    assert len(lines) == 5
+    assert json.loads(lines[-1])['step'] == 4
+
+
+def test_callback_silent_on_nonzero_rank(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYT_PROCESS_ID', '3')
+    log_dir = str(tmp_path / 'bench')
+    callbacks.init(log_dir=log_dir)
+    callbacks.on_step_end()
+    callbacks.close()
+    assert not os.path.exists(log_dir)
+
+
+def test_wrap_step_counts_calls(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYT_PROCESS_ID', '0')
+    log_dir = str(tmp_path / 'bench')
+    callbacks.init(log_dir=log_dir)
+    stepped = callbacks.wrap_step(lambda x: x + 1)
+    assert stepped(1) == 2 and stepped(2) == 3
+    callbacks.close()
+    lines = open(os.path.join(log_dir, 'timestamps.jsonl')).readlines()
+    assert len(lines) == 2
+
+
+def _bench_task():
+    # The job itself uses the callback via the env var the benchmark
+    # launcher injects (SKYT_BENCHMARK_LOG_DIR).
+    run = ('python3 -c "\n'
+           'import time\n'
+           'from skypilot_tpu import callbacks\n'
+           'callbacks.init(total_steps=4)\n'
+           'for _ in range(4):\n'
+           '    time.sleep(0.05); callbacks.on_step_end()\n'
+           'callbacks.close()"')
+    repo_root = os.path.dirname(os.path.dirname(sky.__file__))
+    t = sky.Task(name='benchjob', run=run,
+                 envs={'PYTHONPATH': repo_root})
+    t.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                      cloud='fake'))
+    return t
+
+
+def test_benchmark_end_to_end():
+    task = _bench_task()
+    names = bench_utils.launch_benchmark(
+        task, 'b1',
+        [{'tpu': 'tpu-v5e-8'}, {'tpu': 'tpu-v5e-4'}])
+    assert sorted(names) == ['skyt-bench-b1-0', 'skyt-bench-b1-1']
+
+    # Wait for both candidate jobs to finish.
+    for name in names:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if core.job_status(name, 1) in ('SUCCEEDED', 'FAILED'):
+                break
+            time.sleep(0.2)
+        assert core.job_status(name, 1) == 'SUCCEEDED'
+
+    rows = bench_utils.update_benchmark('b1')
+    by_cluster = {r['cluster']: r for r in rows}
+    for name in names:
+        r = by_cluster[name]
+        assert r['num_steps'] == 4
+        assert r['seconds_per_step'] is not None
+        assert 0.01 < r['seconds_per_step'] < 5.0
+        assert r['total_steps'] == 4
+        assert r['cost_per_step'] is not None and r['cost_per_step'] > 0
+
+    report = bench_utils.format_report('b1')
+    assert 'skyt-bench-b1-0' in report and 'SEC/STEP' in report
+
+    bench_utils.teardown_benchmark('b1')
+    statuses = {r['status'] for r in bench_state.get_results('b1')}
+    assert statuses == {'TERMINATED'}
+    assert core.status(['skyt-bench-b1-0']) == []
+    bench_utils.delete_benchmark('b1')
+    assert bench_state.get_results('b1') == []
