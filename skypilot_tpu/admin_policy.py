@@ -85,6 +85,8 @@ def apply(task: Any,
                           or RequestOptions(),
                           config=config_lib.get_nested([], default={}) or {})
     mutated = policy.validate_and_mutate(request)
+    if mutated.config and mutated.config != request.config:
+        config_lib.set_active_config(mutated.config)
     logger.debug(f'admin policy {policy_path} applied to task '
                  f'{getattr(task, "name", None)!r}')
     return mutated.task

@@ -180,12 +180,15 @@ class CloudTpuBackend:
         (reference: _sync_file_mounts :3197)."""
         if not file_mounts:
             return
+        from skypilot_tpu import cloud_stores
         runners = handle.all_runners()
         for dst, src in file_mounts.items():
-            if src.startswith('gs://'):
-                cmd = (f'mkdir -p $(dirname {dst}) && '
-                       f'gsutil -m rsync -r {shlex.quote(src)} '
-                       f'{shlex.quote(dst)}')
+            if cloud_stores.is_cloud_store_url(src):
+                store = cloud_stores.get_storage_from_path(src)
+                if store.is_directory(src):
+                    cmd = store.make_sync_dir_command(src, dst)
+                else:
+                    cmd = store.make_sync_file_command(src, dst)
                 subprocess_utils.run_in_parallel(
                     lambda r, c=cmd: r.run(c, check=True), runners)
             else:

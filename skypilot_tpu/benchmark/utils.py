@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 import time
 from typing import Any, Dict, List, Optional
 
@@ -121,8 +120,12 @@ def update_benchmark(benchmark: str) -> List[Dict[str, Any]]:
                                     status='TERMINATED')
             return
         handle = record['handle']
-        local_dir = os.path.join(tempfile.mkdtemp(prefix='skyt-bench-'),
+        # Stable per-(benchmark, cluster) dir under SKYT_HOME: repeated
+        # `bench show` calls overwrite instead of leaking tempdirs.
+        home = os.path.expanduser(os.environ.get('SKYT_HOME', '~/.skyt'))
+        local_dir = os.path.join(home, 'benchmark_logs', benchmark,
                                  row['cluster'])
+        os.makedirs(local_dir, exist_ok=True)
         try:
             handle.head_runner().rsync(f'{_REMOTE_LOG_DIR}/{benchmark}/',
                                        local_dir, up=False, check=False)

@@ -344,6 +344,13 @@ def jobs_logs(job_id, follow):
     sys.exit(jobs_core.tail_logs(job_id, follow=follow))
 
 
+@jobs.command(name='dashboard')
+@click.option('--port', '-p', type=int, default=8123)
+def jobs_dashboard(port):
+    from skypilot_tpu.jobs import dashboard
+    dashboard.serve(port=port)
+
+
 @cli.group()
 def serve():
     """Serving with replica autoscaling."""

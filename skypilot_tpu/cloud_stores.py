@@ -1,0 +1,116 @@
+"""Cloud-URI storage command builders for file_mounts.
+
+Reference: sky/cloud_stores.py (561 LoC; `CloudStorage.is_directory`,
+`make_sync_dir_command`, `make_sync_file_command` per scheme, registry at
+the bottom). A task's `file_mounts: {dst: gs://bucket/path}` is satisfied
+by running the returned command ON THE CLUSTER HOSTS, so these builders
+emit plain shell (gcloud storage / gsutil) rather than calling SDKs —
+hosts have cloud CLIs, the client may not.
+
+GCS-first like the rest of the framework; `file://` is the offline test
+scheme (fake cloud hosts share the client filesystem)."""
+from __future__ import annotations
+
+import shlex
+from typing import Dict, Type
+
+from skypilot_tpu import exceptions
+
+
+def _quote_dest(path: str) -> str:
+    """Quote a destination path while keeping '~/...' expandable: the
+    command runs on the cluster host where HOME differs from the client
+    (and the fake cloud remaps it), so a shlex-quoted literal '~' would
+    never resolve."""
+    if path == '~' or path.startswith('~/'):
+        rest = path[1:].lstrip('/')
+        return f'"$HOME/{rest}"'
+    return shlex.quote(path)
+
+
+class CloudStorage:
+    """Per-scheme command builders (reference: cloud_stores.py:32)."""
+
+    def is_directory(self, url: str) -> bool:
+        """Best-effort: whether url names a 'directory' (prefix)."""
+        raise NotImplementedError
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        raise NotImplementedError
+
+
+def gcs_cli_cmd(args: str) -> str:
+    """`gcloud storage` with gsutil fallback (the newer CLI is markedly
+    faster for many-object rsync). Shared with data/data_transfer.py."""
+    return ('(command -v gcloud >/dev/null && '
+            f'gcloud storage {args} || gsutil -m {args})')
+
+
+class GcsCloudStorage(CloudStorage):
+    """gs:// command builders running on the cluster host."""
+
+    def is_directory(self, url: str) -> bool:
+        # The client may have no GCS credentials, so prefix-vs-object is
+        # resolved REMOTELY: report True and let make_sync_dir_command's
+        # rsync-else-cp fallback handle single objects.
+        del url
+        return True
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        dest_q = _quote_dest(destination)
+        src_q = shlex.quote(source.rstrip('/'))
+        rsync = gcs_cli_cmd(f'rsync -r {src_q} {dest_q}')
+        cp = gcs_cli_cmd(f'cp {src_q} {dest_q}/')
+        # Prefix -> rsync; single object -> rsync fails, cp picks it up.
+        return f'mkdir -p {dest_q} && ({rsync} || {cp})'
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        dest_q = _quote_dest(destination)
+        src_q = shlex.quote(source)
+        inner = gcs_cli_cmd(f'cp {src_q} {dest_q}')
+        return f'mkdir -p $(dirname {dest_q}) && {inner}'
+
+
+class FileCloudStorage(CloudStorage):
+    """file:// for the fake cloud: hosts see the client filesystem, so a
+    plain cp is the 'cloud fetch'. Keeps the whole file-mount path
+    testable offline (the substrate gap SURVEY.md §4 calls out)."""
+
+    def _path(self, url: str) -> str:
+        return url[len('file://'):]
+
+    def is_directory(self, url: str) -> bool:
+        import os
+        return os.path.isdir(self._path(url))
+
+    def make_sync_dir_command(self, source: str, destination: str) -> str:
+        src = shlex.quote(self._path(source).rstrip('/'))
+        dst = _quote_dest(destination)
+        return f'mkdir -p {dst} && cp -r {src}/. {dst}/'
+
+    def make_sync_file_command(self, source: str, destination: str) -> str:
+        src = shlex.quote(self._path(source))
+        dst = _quote_dest(destination)
+        return f'mkdir -p $(dirname {dst}) && cp {src} {dst}'
+
+
+_REGISTRY: Dict[str, Type[CloudStorage]] = {
+    'gs://': GcsCloudStorage,
+    'file://': FileCloudStorage,
+}
+
+
+def is_cloud_store_url(url: str) -> bool:
+    return any(url.startswith(scheme) for scheme in _REGISTRY)
+
+
+def get_storage_from_path(url: str) -> CloudStorage:
+    for scheme, cls in _REGISTRY.items():
+        if url.startswith(scheme):
+            return cls()
+    raise exceptions.StorageSpecError(
+        f'Unsupported storage URL scheme: {url!r} '
+        f'(supported: {", ".join(_REGISTRY)})')

@@ -65,6 +65,16 @@ def reload() -> None:
         _config_cache = None
 
 
+def set_active_config(cfg: dict) -> None:
+    """Replace the in-process config (admin policies may rewrite it; the
+    mutated dict governs the rest of this launch — reference swaps
+    skypilot_config the same way)."""
+    global _config_cache, _config_cache_path
+    with _lock:
+        _config_cache = dict(cfg)
+        _config_cache_path = str(home_dir() / 'config.yaml')
+
+
 def get_nested(keys: List[str], default: Any = None) -> Any:
     """config.yaml nested lookup, e.g. get_nested(['gcp', 'project_id'])."""
     node: Any = _load_config()

@@ -1,0 +1,60 @@
+"""Cross-bucket data transfer (reference: sky/data/data_transfer.py —
+gsutil/aws-s3/azcopy command paths + the GCS Storage Transfer Service for
+big cross-cloud moves).
+
+GCS-first: in-cloud GCS->GCS rsync via the storage CLI, local<->GCS via
+the python client when available (storage.GcsStore) or the CLI. All
+functions degrade to returning the would-be command with `dryrun=True`
+so the path is testable without network."""
+from __future__ import annotations
+
+import shlex
+import subprocess
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.cloud_stores import gcs_cli_cmd as _storage_cli_cmd
+from skypilot_tpu.utils import timeline
+
+logger = sky_logging.init_logger(__name__)
+
+
+@timeline.event
+def gcs_to_gcs(src_bucket: str, dst_bucket: str,
+               src_prefix: str = '', dst_prefix: str = '',
+               dryrun: bool = False) -> Optional[str]:
+    """Server-side GCS->GCS copy (no client egress: the storage service
+    moves bytes bucket-to-bucket directly)."""
+    src = f'gs://{src_bucket}/{src_prefix}'.rstrip('/')
+    dst = f'gs://{dst_bucket}/{dst_prefix}'.rstrip('/')
+    cmd = _storage_cli_cmd(
+        f'rsync -r {shlex.quote(src)} {shlex.quote(dst)}')
+    if dryrun:
+        return cmd
+    logger.info(f'GCS transfer {src} -> {dst}')
+    subprocess.run(['bash', '-c', cmd], check=True)
+    return None
+
+
+@timeline.event
+def local_to_gcs(local_path: str, bucket: str, prefix: str = '',
+                 dryrun: bool = False) -> Optional[str]:
+    dst = f'gs://{bucket}/{prefix}'.rstrip('/')
+    cmd = _storage_cli_cmd(
+        f'rsync -r {shlex.quote(local_path)} {shlex.quote(dst)}')
+    if dryrun:
+        return cmd
+    subprocess.run(['bash', '-c', cmd], check=True)
+    return None
+
+
+@timeline.event
+def gcs_to_local(bucket: str, local_path: str, prefix: str = '',
+                 dryrun: bool = False) -> Optional[str]:
+    src = f'gs://{bucket}/{prefix}'.rstrip('/')
+    cmd = _storage_cli_cmd(
+        f'rsync -r {shlex.quote(src)} {shlex.quote(local_path)}')
+    if dryrun:
+        return cmd
+    subprocess.run(['bash', '-c', cmd], check=True)
+    return None

@@ -29,6 +29,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.backend import CloudTpuBackend
 from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
 from skypilot_tpu.jobs import state
 
 logger = sky_logging.init_logger(__name__)
@@ -86,7 +87,8 @@ class JobsController:
 
         state.set_status(self.job_id, state.ManagedJobStatus.STARTING)
         try:
-            strategy.launch()
+            with scheduler.scheduled_launch(self.job_id):
+                strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
             state.set_status(self.job_id,
                              state.ManagedJobStatus.FAILED_NO_RESOURCE,
@@ -123,7 +125,8 @@ class JobsController:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.RECOVERING)
                 try:
-                    strategy.recover()
+                    with scheduler.scheduled_launch(self.job_id):
+                        strategy.recover()
                 except exceptions.ResourcesUnavailableError as e:
                     state.set_status(
                         self.job_id,
@@ -147,7 +150,8 @@ class JobsController:
             state.set_status(self.job_id,
                              state.ManagedJobStatus.RECOVERING)
             try:
-                strategy.recover()
+                with scheduler.scheduled_launch(self.job_id):
+                    strategy.recover()
             except exceptions.ResourcesUnavailableError as e:
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.FAILED_NO_RESOURCE,
@@ -204,6 +208,8 @@ class JobsController:
                     self._down(record['cluster_name'])
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
+            # Release scheduler slots and admit the next WAITING job.
+            scheduler.job_done(self.job_id)
 
 
 def main() -> None:

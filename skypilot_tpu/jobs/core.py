@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import os
 import signal
-import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -56,17 +55,23 @@ def launch(task_or_dag, name: Optional[str] = None,
     job_id = state.add_job(job_name, dag_yaml, log_path)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
 
-    with open(log_path, 'ab') as log_f:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
-             '--job-id', str(job_id)],
-            stdout=log_f, stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL, start_new_session=True)
-    state.set_controller_pid(job_id, proc.pid)
-    logger.info(f'Managed job {job_id} ({job_name!r}) submitted; '
-                f'controller pid {proc.pid}.')
+    # Admission control decides when the controller process starts
+    # (reference: jobs/scheduler.py caps by controller CPU/memory).
+    from skypilot_tpu.jobs import scheduler
+    scheduler.maybe_schedule_next_jobs()
+    record = state.get_job(job_id)
+    if record['schedule_state'] == state.ManagedJobScheduleState.WAITING:
+        logger.info(f'Managed job {job_id} ({job_name!r}) queued '
+                    '(admission caps reached); it starts when a slot '
+                    'frees.')
+    else:
+        logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
     if not detach:
-        proc.wait()
+        while True:
+            record = state.get_job(job_id)
+            if record['status'].is_terminal():
+                break
+            time.sleep(0.5)
     return job_id
 
 
@@ -97,8 +102,11 @@ def cancel(job_id: int) -> None:
             return
         except ProcessLookupError:
             pass
-    # Controller is gone: clean up directly.
+    # Controller is gone (or never started — WAITING): clean up directly
+    # and release the scheduler slot.
     state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+    from skypilot_tpu.jobs import scheduler
+    scheduler.job_done(job_id)
     if record['cluster_name']:
         from skypilot_tpu import core, global_user_state
         if global_user_state.get_cluster(record['cluster_name']):

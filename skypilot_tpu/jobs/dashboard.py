@@ -1,0 +1,89 @@
+"""Managed-jobs dashboard (reference: sky/jobs/dashboard/ — a Flask app
++ HTML template served from the controller). Stdlib-only here: one
+http.server handler rendering the queue as an auto-refreshing table,
+plus a JSON endpoint (/api/jobs) for tooling."""
+from __future__ import annotations
+
+import html
+import json
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from skypilot_tpu.jobs import core as jobs_core
+
+_STATUS_COLORS = {
+    'RUNNING': '#2da44e', 'SUCCEEDED': '#1a7f37', 'PENDING': '#9a6700',
+    'SUBMITTED': '#9a6700', 'STARTING': '#9a6700',
+    'RECOVERING': '#bc4c00', 'CANCELLING': '#57606a',
+    'CANCELLED': '#57606a',
+}
+
+_PAGE = """<!doctype html>
+<html><head><title>skyt managed jobs</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ border: 1px solid #d0d7de; padding: 6px 12px;
+           text-align: left; }}
+ th {{ background: #f6f8fa; }}
+</style></head>
+<body><h2>Managed jobs</h2>
+<p>{count} jobs &middot; refreshed {now}</p>
+<table>
+<tr><th>ID</th><th>NAME</th><th>STATUS</th><th>RECOVERIES</th>
+<th>CLUSTER</th><th>SUBMITTED</th><th>FAILURE</th></tr>
+{rows}
+</table></body></html>"""
+
+
+def _render() -> str:
+    rows = []
+    for j in jobs_core.queue():
+        status = j['status']
+        color = _STATUS_COLORS.get(status, '#cf222e')
+        sub = time.strftime('%m-%d %H:%M',
+                            time.localtime(j['submitted_at'] or 0))
+        rows.append(
+            '<tr><td>{id}</td><td>{name}</td>'
+            '<td style="color:{color};font-weight:bold">{status}</td>'
+            '<td>{rec}</td><td>{cluster}</td><td>{sub}</td>'
+            '<td>{fail}</td></tr>'.format(
+                id=j['job_id'], name=html.escape(j['name'] or '-'),
+                color=color, status=status, rec=j['recoveries'],
+                cluster=html.escape(j['cluster_name'] or '-'), sub=sub,
+                fail=html.escape((j['failure_reason'] or '')[:80])))
+    return _PAGE.format(count=len(rows),
+                        now=time.strftime('%H:%M:%S'),
+                        rows='\n'.join(rows))
+
+
+class _Handler(BaseHTTPRequestHandler):
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path.startswith('/api/jobs'):
+            body = json.dumps(jobs_core.queue()).encode()
+            ctype = 'application/json'
+        else:
+            body = _render().encode()
+            ctype = 'text/html; charset=utf-8'
+        self.send_response(200)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        del args
+
+
+def serve(host: str = '127.0.0.1', port: int = 8123) -> None:
+    server = ThreadingHTTPServer((host, port), _Handler)
+    print(f'Jobs dashboard: http://{host}:{server.server_address[1]}')
+    server.serve_forever()
+
+
+def make_server(host: str = '127.0.0.1',
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind-only variant for embedding/tests (port 0 = ephemeral)."""
+    return ThreadingHTTPServer((host, port), _Handler)

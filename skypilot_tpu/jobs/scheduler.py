@@ -1,0 +1,163 @@
+"""Managed-jobs admission control (reference: sky/jobs/scheduler.py,
+292 LoC — caps concurrent sky.launch calls and alive jobs by controller
+CPU/memory; maybe_schedule_next_jobs :79; scheduled_launch :192).
+
+Two resource caps, both config-overridable:
+  * launch slots (`jobs.max_parallel_launches`, default = cpu_count):
+    a sky.launch/recover is provision-API + SSH heavy, so only this many
+    run concurrently framework-wide.
+  * alive jobs (`jobs.max_parallel_jobs`, default = 2x cpu_count): each
+    alive job is one controller process polling its cluster.
+
+Jobs submit into WAITING; `maybe_schedule_next_jobs()` (called on submit
+and whenever a slot frees) pops WAITING jobs FIFO while both caps allow,
+flips them to LAUNCHING and spawns their controller process. The
+controller's launches/recoveries re-acquire a launch slot via the
+`scheduled_launch` context manager. All transitions happen under one
+inter-process file lock, like the reference's filelock around its
+scheduler state.
+"""
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import os
+import subprocess
+import sys
+import time
+from typing import Iterator, Optional
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state
+
+logger = sky_logging.init_logger(__name__)
+
+_SLOT_POLL_SECONDS = 0.5
+
+
+def max_parallel_launches() -> int:
+    return int(config_lib.get_nested(['jobs', 'max_parallel_launches'],
+                                     os.cpu_count() or 4))
+
+
+def max_parallel_jobs() -> int:
+    return int(config_lib.get_nested(['jobs', 'max_parallel_jobs'],
+                                     2 * (os.cpu_count() or 4)))
+
+
+@contextlib.contextmanager
+def _lock() -> Iterator[None]:
+    path = str(config_lib.home_dir() / '.jobs_scheduler.lock')
+    with open(path, 'w') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _reclaim_dead_slots() -> None:
+    """A controller that died without its finally block (SIGKILL, OOM,
+    reboot) leaves its row pinned in LAUNCHING/ALIVE and would leak the
+    slot forever; reap it here (the reference scheduler checks controller
+    liveness the same way). Call under _lock()."""
+    stuck = state.jobs_in_schedule_states(
+        [state.ManagedJobScheduleState.LAUNCHING,
+         state.ManagedJobScheduleState.ALIVE])
+    for record in stuck:
+        if _pid_alive(record['controller_pid']):
+            continue
+        job_id = record['job_id']
+        if not record['status'].is_terminal():
+            logger.warning(
+                f'Managed job {job_id} controller (pid '
+                f'{record["controller_pid"]}) died; marking '
+                'FAILED_CONTROLLER and reclaiming its slot.')
+            state.set_status(job_id,
+                             state.ManagedJobStatus.FAILED_CONTROLLER,
+                             failure_reason='controller process died')
+        state.set_schedule_state(job_id,
+                                 state.ManagedJobScheduleState.DONE)
+
+
+def _launching_count() -> int:
+    return state.count_schedule_state(
+        state.ManagedJobScheduleState.LAUNCHING)
+
+
+def _alive_count() -> int:
+    return (_launching_count()
+            + state.count_schedule_state(
+                state.ManagedJobScheduleState.ALIVE))
+
+
+def _spawn_controller(job_id: int) -> None:
+    record = state.get_job(job_id)
+    with open(record['log_path'], 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    state.set_controller_pid(job_id, proc.pid)
+    logger.info(f'Managed job {job_id} scheduled; controller pid '
+                f'{proc.pid}.')
+
+
+def maybe_schedule_next_jobs() -> None:
+    """Admit WAITING jobs while both caps have headroom."""
+    with _lock():
+        _reclaim_dead_slots()
+        while True:
+            if _launching_count() >= max_parallel_launches():
+                return
+            if _alive_count() >= max_parallel_jobs():
+                return
+            job_id = state.next_waiting_job()
+            if job_id is None:
+                return
+            state.set_schedule_state(
+                job_id, state.ManagedJobScheduleState.LAUNCHING)
+            _spawn_controller(job_id)
+
+
+@contextlib.contextmanager
+def scheduled_launch(job_id: int) -> Iterator[None]:
+    """Hold a launch slot for the duration of a sky.launch/recover.
+
+    A freshly scheduled job already holds its slot (state LAUNCHING from
+    admission); a recovery must wait for one. Exiting flips to ALIVE and
+    wakes the scheduler."""
+    record = state.get_job(job_id)
+    if (record is not None and record['schedule_state']
+            != state.ManagedJobScheduleState.LAUNCHING):
+        while True:
+            with _lock():
+                if _launching_count() < max_parallel_launches():
+                    state.set_schedule_state(
+                        job_id, state.ManagedJobScheduleState.LAUNCHING)
+                    break
+            time.sleep(_SLOT_POLL_SECONDS)
+    try:
+        yield
+    finally:
+        state.set_schedule_state(job_id,
+                                 state.ManagedJobScheduleState.ALIVE)
+        maybe_schedule_next_jobs()
+
+
+def job_done(job_id: int) -> None:
+    """Terminal transition: release all slots and admit the next job."""
+    state.set_schedule_state(job_id, state.ManagedJobScheduleState.DONE)
+    maybe_schedule_next_jobs()

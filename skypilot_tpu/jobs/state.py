@@ -14,6 +14,17 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import config as config_lib
 
 
+class ManagedJobScheduleState(enum.Enum):
+    """Admission-control state, orthogonal to ManagedJobStatus
+    (reference: sky/jobs/state.py:313). WAITING jobs have no controller
+    process yet; LAUNCHING jobs hold a launch slot (sky.launch in
+    flight); ALIVE jobs are monitoring; DONE releases both."""
+    WAITING = 'WAITING'
+    LAUNCHING = 'LAUNCHING'
+    ALIVE = 'ALIVE'
+    DONE = 'DONE'
+
+
 class ManagedJobStatus(enum.Enum):
     """Reference: sky/jobs/state.py:187."""
     PENDING = 'PENDING'
@@ -56,8 +67,21 @@ def _conn() -> sqlite3.Connection:
             controller_pid INTEGER,
             cluster_name TEXT,
             log_path TEXT,
-            failure_reason TEXT)
+            failure_reason TEXT,
+            schedule_state TEXT DEFAULT 'WAITING')
     """)
+    try:
+        conn.execute("ALTER TABLE managed_jobs ADD COLUMN "
+                     "schedule_state TEXT DEFAULT 'WAITING'")
+        # Backfill: finished historical jobs must not be re-admitted as
+        # WAITING by the scheduler.
+        terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
+        conn.execute(
+            "UPDATE managed_jobs SET schedule_state='DONE' WHERE status "
+            f"IN ({','.join('?' * len(terminal))})", terminal)
+        conn.commit()
+    except sqlite3.OperationalError:
+        pass  # column already exists
     return conn
 
 
@@ -110,20 +134,54 @@ def bump_recoveries(job_id: int) -> int:
         return row[0]
 
 
+def set_schedule_state(job_id: int,
+                       sched: ManagedJobScheduleState) -> None:
+    with _conn() as conn:
+        conn.execute('UPDATE managed_jobs SET schedule_state=? '
+                     'WHERE job_id=?', (sched.value, job_id))
+
+
+def count_schedule_state(sched: ManagedJobScheduleState) -> int:
+    row = _conn().execute(
+        'SELECT COUNT(*) FROM managed_jobs WHERE schedule_state=?',
+        (sched.value,)).fetchone()
+    return row[0]
+
+
+def next_waiting_job() -> Optional[int]:
+    terminal = [s.value for s in ManagedJobStatus if s.is_terminal()]
+    row = _conn().execute(
+        "SELECT job_id FROM managed_jobs WHERE schedule_state='WAITING' "
+        f"AND status NOT IN ({','.join('?' * len(terminal))}) "
+        'ORDER BY job_id ASC LIMIT 1', terminal).fetchone()
+    return row[0] if row else None
+
+
+def jobs_in_schedule_states(scheds: List[ManagedJobScheduleState]
+                            ) -> List[Dict[str, Any]]:
+    vals = [s.value for s in scheds]
+    rows = _conn().execute(
+        f'SELECT {_COLS} FROM managed_jobs WHERE schedule_state IN '
+        f"({','.join('?' * len(vals))})", vals).fetchall()
+    return [_row(r) for r in rows]
+
+
+_COLS = ('job_id, name, dag_yaml, status, submitted_at, started_at,'
+         ' ended_at, recoveries, controller_pid, cluster_name, log_path,'
+         ' failure_reason, schedule_state')
+
+
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:
     row = _conn().execute(
-        'SELECT job_id, name, dag_yaml, status, submitted_at, started_at,'
-        ' ended_at, recoveries, controller_pid, cluster_name, log_path,'
-        ' failure_reason FROM managed_jobs WHERE job_id=?',
+        f'SELECT {_COLS} FROM managed_jobs WHERE job_id=?',
         (job_id,)).fetchone()
     return _row(row) if row else None
 
 
 def get_jobs() -> List[Dict[str, Any]]:
     rows = _conn().execute(
-        'SELECT job_id, name, dag_yaml, status, submitted_at, started_at,'
-        ' ended_at, recoveries, controller_pid, cluster_name, log_path,'
-        ' failure_reason FROM managed_jobs ORDER BY job_id DESC').fetchall()
+        f'SELECT {_COLS} FROM managed_jobs ORDER BY job_id DESC'
+    ).fetchall()
     return [_row(r) for r in rows]
 
 
@@ -134,4 +192,5 @@ def _row(row) -> Dict[str, Any]:
         'started_at': row[5], 'ended_at': row[6], 'recoveries': row[7],
         'controller_pid': row[8], 'cluster_name': row[9],
         'log_path': row[10], 'failure_reason': row[11],
+        'schedule_state': ManagedJobScheduleState(row[12] or 'WAITING'),
     }

@@ -20,6 +20,7 @@ import functools
 import hashlib
 import json
 import os
+import threading
 import time
 import traceback
 import uuid
@@ -69,6 +70,13 @@ def _emit(message: dict) -> None:
     endpoint = os.environ.get(ENV_ENDPOINT)
     if not endpoint:
         return
+    # POST from a daemon thread: a slow/unreachable endpoint must not add
+    # latency to the API call it instruments.
+    threading.Thread(target=_post, args=(endpoint, message),
+                     daemon=True).start()
+
+
+def _post(endpoint: str, message: dict) -> None:
     try:  # Loki push-API shape, like the reference's Grafana stack.
         import urllib.request
         payload = json.dumps({

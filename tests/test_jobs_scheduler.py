@@ -1,0 +1,101 @@
+"""Jobs admission scheduler + dashboard tests.
+
+Reference semantics under test (sky/jobs/scheduler.py): WAITING jobs are
+admitted FIFO while launch/alive caps allow; finishing a job admits the
+next; cancel of a WAITING job releases its slot.
+"""
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import yaml
+
+import skypilot_tpu as sky
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import scheduler, state
+
+
+def _submit(name, run='true', sleep=None):
+    t = sky.Task(name=name, run=run if sleep is None
+                 else f'sleep {sleep}')
+    t.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                      cloud='fake'))
+    return jobs_core.launch(t, name=name)
+
+
+def _wait_status(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        record = state.get_job(job_id)
+        if record['status'].value in statuses:
+            return record['status'].value
+        time.sleep(0.2)
+    raise TimeoutError(
+        f'job {job_id} still {state.get_job(job_id)["status"]}')
+
+
+def test_admission_caps_respected(monkeypatch):
+    """With caps forced to 1, the second job stays WAITING until the
+    first finishes, then runs."""
+    home = os.path.expanduser(os.environ['SKYT_HOME'])
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, 'config.yaml'), 'w') as f:
+        yaml.dump({'jobs': {'max_parallel_launches': 1,
+                            'max_parallel_jobs': 1}}, f)
+    from skypilot_tpu import config
+    config.reload()
+    monkeypatch.setenv('SKYT_JOBS_POLL_SECONDS', '0.3')
+
+    j1 = _submit('first', sleep=3)
+    j2 = _submit('second')
+
+    # j2 must be WAITING while j1 occupies the single slot.
+    r2 = state.get_job(j2)
+    assert r2['schedule_state'] == state.ManagedJobScheduleState.WAITING
+    assert r2['controller_pid'] is None
+
+    assert _wait_status(j1, {'SUCCEEDED'}) == 'SUCCEEDED'
+    # j1 done -> j2 admitted and completes.
+    assert _wait_status(j2, {'SUCCEEDED'}) == 'SUCCEEDED'
+    assert state.get_job(j1)['schedule_state'] == \
+        state.ManagedJobScheduleState.DONE
+
+
+def test_cancel_waiting_job_releases_slot(monkeypatch):
+    home = os.path.expanduser(os.environ['SKYT_HOME'])
+    os.makedirs(home, exist_ok=True)
+    with open(os.path.join(home, 'config.yaml'), 'w') as f:
+        yaml.dump({'jobs': {'max_parallel_launches': 1,
+                            'max_parallel_jobs': 1}}, f)
+    from skypilot_tpu import config
+    config.reload()
+    monkeypatch.setenv('SKYT_JOBS_POLL_SECONDS', '0.3')
+
+    j1 = _submit('blocker', sleep=3)
+    j2 = _submit('queued')
+    jobs_core.cancel(j2)
+    record = state.get_job(j2)
+    assert record['status'] == state.ManagedJobStatus.CANCELLED
+    assert record['schedule_state'] == state.ManagedJobScheduleState.DONE
+    assert _wait_status(j1, {'SUCCEEDED'}) == 'SUCCEEDED'
+
+
+def test_dashboard_serves_queue():
+    j1 = _submit('dash')
+    _wait_status(j1, {'SUCCEEDED'})
+    from skypilot_tpu.jobs import dashboard
+    server = dashboard.make_server(port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        page = urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/').read().decode()
+        assert 'dash' in page and 'SUCCEEDED' in page
+        api = json.loads(urllib.request.urlopen(
+            f'http://127.0.0.1:{port}/api/jobs').read())
+        assert any(j['name'] == 'dash' for j in api)
+    finally:
+        server.shutdown()
