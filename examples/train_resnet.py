@@ -1,0 +1,61 @@
+"""ResNet training recipe (reference parity:
+examples/resnet_distributed_torch.yaml, but SPMD in-framework instead of
+torchrun DDP). Synthetic data; swap in a real input pipeline for actual
+runs."""
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from skypilot_tpu import callbacks
+from skypilot_tpu.models import resnet
+from skypilot_tpu.parallel import distributed, mesh as mesh_lib
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--steps', type=int, default=20)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--image-size', type=int, default=224)
+    p.add_argument('--arch', default='resnet50',
+                   choices=['resnet18', 'resnet50', 'tiny'])
+    args = p.parse_args()
+
+    distributed.initialize_from_env()
+    n = jax.device_count()
+    mesh = mesh_lib.make_mesh(mesh_lib.default_mesh_shape(n))
+    cfg = {'resnet18': resnet.resnet18, 'resnet50': resnet.resnet50,
+           'tiny': resnet.resnet_tiny}[args.arch]()
+    print(f'{cfg.name} on {n} devices')
+
+    state, model, opt = resnet.init_train_state(
+        cfg, mesh, optimizer=optax.sgd(0.1, momentum=0.9),
+        image_size=args.image_size)
+    step = resnet.make_train_step(model, mesh, opt)
+
+    key = jax.random.PRNGKey(0)
+    batch = {
+        'images': jax.random.uniform(
+            key, (args.batch_size, args.image_size, args.image_size, 3)),
+        'labels': jax.random.randint(key, (args.batch_size,), 0,
+                                     cfg.num_classes),
+    }
+    callbacks.init(total_steps=args.steps)
+    t0 = time.time()
+    for i in range(args.steps):
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics['loss'])
+        callbacks.on_step_end()
+        if i in (0, args.steps - 1) or i % 10 == 0:
+            print(f'step {i} loss {float(metrics["loss"]):.4f} '
+                  f'({args.batch_size * (i + 1) / (time.time() - t0):.1f}'
+                  ' img/s)')
+    callbacks.close()
+
+
+if __name__ == '__main__':
+    main()

@@ -63,9 +63,12 @@ class GcsCloudStorage(CloudStorage):
         dest_q = _quote_dest(destination)
         src_q = shlex.quote(source.rstrip('/'))
         rsync = gcs_cli_cmd(f'rsync -r {src_q} {dest_q}')
-        cp = gcs_cli_cmd(f'cp {src_q} {dest_q}/')
-        # Prefix -> rsync; single object -> rsync fails, cp picks it up.
-        return f'mkdir -p {dest_q} && ({rsync} || {cp})'
+        cp = gcs_cli_cmd(f'cp {src_q} {dest_q}')
+        # Prefix -> rsync into the pre-made dir. Single object -> rsync
+        # fails; drop the (empty) dir so cp lands the file AT the
+        # destination path, not nested inside it.
+        return (f'mkdir -p {dest_q} && '
+                f'({rsync} || (rmdir {dest_q} 2>/dev/null; {cp}))')
 
     def make_sync_file_command(self, source: str, destination: str) -> str:
         dest_q = _quote_dest(destination)

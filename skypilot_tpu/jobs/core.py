@@ -67,10 +67,17 @@ def launch(task_or_dag, name: Optional[str] = None,
     else:
         logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
     if not detach:
+        last_reap = time.time()
         while True:
             record = state.get_job(job_id)
             if record['status'].is_terminal():
                 break
+            # Reap dead controllers periodically so a SIGKILLed
+            # controller surfaces as FAILED_CONTROLLER instead of
+            # spinning here forever.
+            if time.time() - last_reap > 5:
+                scheduler.maybe_schedule_next_jobs()
+                last_reap = time.time()
             time.sleep(0.5)
     return job_id
 
@@ -88,6 +95,7 @@ def queue() -> List[Dict[str, Any]]:
 
 
 def cancel(job_id: int) -> None:
+    from skypilot_tpu.jobs import scheduler
     record = state.get_job(job_id)
     if record is None:
         raise exceptions.JobNotFoundError(f'Managed job {job_id} not found')
@@ -95,6 +103,11 @@ def cancel(job_id: int) -> None:
         logger.info(f'Managed job {job_id} already '
                     f'{record["status"].value}.')
         return
+    # Not yet admitted: cancel under the scheduler lock so the admission
+    # path cannot spawn a controller for it concurrently.
+    if scheduler.try_cancel_waiting(job_id):
+        return
+    record = state.get_job(job_id)
     pid = record['controller_pid']
     if pid:
         try:
@@ -102,10 +115,8 @@ def cancel(job_id: int) -> None:
             return
         except ProcessLookupError:
             pass
-    # Controller is gone (or never started — WAITING): clean up directly
-    # and release the scheduler slot.
+    # Controller died without cleanup: finish the cancel directly.
     state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
-    from skypilot_tpu.jobs import scheduler
     scheduler.job_done(job_id)
     if record['cluster_name']:
         from skypilot_tpu import core, global_user_state

@@ -63,8 +63,12 @@ def _pid_alive(pid: Optional[int]) -> bool:
     try:
         os.kill(pid, 0)
         return True
-    except (ProcessLookupError, PermissionError):
+    except ProcessLookupError:
         return False
+    except PermissionError:
+        # EPERM means the process EXISTS (owned by another user) —
+        # reaping it would orphan a live controller.
+        return True
 
 
 def _reclaim_dead_slots() -> None:
@@ -161,3 +165,19 @@ def job_done(job_id: int) -> None:
     """Terminal transition: release all slots and admit the next job."""
     state.set_schedule_state(job_id, state.ManagedJobScheduleState.DONE)
     maybe_schedule_next_jobs()
+
+
+def try_cancel_waiting(job_id: int) -> bool:
+    """Atomically cancel a not-yet-admitted job. Returns False if the
+    scheduler got there first (a controller process exists — the caller
+    must signal it instead). Prevents the cancel/admit race: both
+    transitions happen under the same lock."""
+    with _lock():
+        record = state.get_job(job_id)
+        if (record is None or record['schedule_state']
+                != state.ManagedJobScheduleState.WAITING):
+            return False
+        state.set_status(job_id, state.ManagedJobStatus.CANCELLED)
+        state.set_schedule_state(job_id,
+                                 state.ManagedJobScheduleState.DONE)
+        return True

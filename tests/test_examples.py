@@ -1,0 +1,40 @@
+"""Every example YAML must parse through the real Task/Resources/Service
+path, and the recipe scripts must run (tiny configs, CPU mesh)."""
+import glob
+import os
+import subprocess
+import sys
+
+import pytest
+
+import skypilot_tpu as sky
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(sky.__file__)),
+                        'examples')
+
+
+@pytest.mark.parametrize('path', sorted(glob.glob(f'{EXAMPLES}/*.yaml')))
+def test_example_yaml_parses(path):
+    task = sky.Task.from_yaml(path)
+    assert task.run
+    assert task.resources.tpu is not None
+    if 'serve' in os.path.basename(path):
+        assert task.service is not None
+        assert task.service.min_replicas >= 1
+
+
+@pytest.mark.parametrize('script,args', [
+    ('train_llm.py', ['--model', 'llama-tiny', '--steps', '2',
+                      '--batch-size', '8', '--seq-len', '128']),
+    ('train_resnet.py', ['--arch', 'tiny', '--steps', '2',
+                         '--batch-size', '16', '--image-size', '32']),
+])
+def test_example_script_runs(script, args):
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(EXAMPLES),
+               JAX_PLATFORMS='cpu')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script)] + args,
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert 'loss' in proc.stdout

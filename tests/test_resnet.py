@@ -1,0 +1,49 @@
+"""ResNet model-family tests on the virtual CPU mesh (reference parity:
+examples/resnet_distributed_torch.yaml — torch DDP at recipe level; here
+the SPMD train step is in-framework)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from skypilot_tpu.models import resnet
+from skypilot_tpu.parallel import mesh as mesh_lib
+
+
+def test_forward_shapes():
+    cfg = resnet.resnet_tiny()
+    model = resnet.ResNet(cfg)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, cfg.num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_train_step_dp_sharded_loss_falls():
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(dp=4, fsdp=2),
+                              devices=jax.devices()[:8])
+    cfg = resnet.resnet_tiny()
+    state, model, opt = resnet.init_train_state(
+        cfg, mesh, optimizer=optax.adam(1e-3), image_size=32)
+    step = resnet.make_train_step(model, mesh, opt)
+    rng = jax.random.PRNGKey(1)
+    # A learnable mapping: label = brightness bucket.
+    images = jax.random.uniform(rng, (16, 32, 32, 3))
+    labels = (jnp.mean(images, axis=(1, 2, 3)) * cfg.num_classes
+              ).astype(jnp.int32) % cfg.num_classes
+    batch = {'images': images, 'labels': labels}
+    state, first = step(state, batch)
+    for _ in range(8):
+        state, metrics = step(state, batch)
+    assert float(metrics['loss']) < float(first['loss'])
+    assert int(state['step']) == 9
+    # Batch stats actually updated (BN is live).
+    flat = jax.tree.leaves(state['batch_stats'])
+    assert any(float(jnp.abs(x).sum()) > 0 for x in flat)
+
+
+def test_config_names():
+    assert resnet.resnet50().name == 'ResNet-50'
+    assert resnet.resnet18().name == 'ResNet-18'
+    assert resnet.resnet_tiny().name == 'ResNet-custom'
