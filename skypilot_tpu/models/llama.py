@@ -47,6 +47,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
+    # Ring attention over the 'sp' axis (long context): requires a mesh
+    # with sp > 1 active via parallel.mesh.use_mesh (the trainer does
+    # this automatically).
+    ring_attention: bool = False
     # vjp-friendly toggle for scanning layers; False unrolls (debugging).
     scan_layers: bool = True
 
@@ -211,8 +215,42 @@ def _kernel_compatible(q: jax.Array) -> bool:
     return seq >= 128 and seq % block == 0
 
 
+def _ring_attention_sharded(q: jax.Array, k: jax.Array,
+                            v: jax.Array, mesh) -> jax.Array:
+    """Ring attention over the 'sp'-sharded sequence (parallel/ring.py):
+    KV chunks rotate around the ring via nearest-neighbor ppermute, so
+    long-context attention never materializes the full sequence on one
+    chip. q/k/v are [B, S, H|KV, hd] in model layout."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.parallel import ring
+    q_spec = P(('dp', 'fsdp'), 'sp', 'tp', None)
+
+    def _ring(ql, kl, vl):
+        return ring.ring_attention_bshd(ql, kl, vl, axis_name='sp')
+
+    return mesh_lib.compat_shard_map(
+        _ring, mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec),
+        out_specs=q_spec, check_vma=False)(q, k, v)
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               cfg: LlamaConfig) -> jax.Array:
+    if cfg.ring_attention:
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.current_mesh()
+        if mesh is None:
+            # Refuse rather than silently trace dense attention: the jit
+            # cache is keyed on shapes only, so a dense trace here would
+            # shadow the ring path for identical shapes later — OOM at
+            # exactly the lengths ring attention exists for.
+            raise ValueError(
+                'cfg.ring_attention=True but no mesh is active; wrap '
+                'the call in parallel.mesh.use_mesh(mesh) (the trainer '
+                'does this automatically), or unset the flag for dense '
+                'eval.')
+        if mesh.shape.get('sp', 1) > 1:
+            return _ring_attention_sharded(q, k, v, mesh)
     if cfg.use_flash_attention and _kernel_compatible(q):
         from skypilot_tpu.ops import flash_attention
         return flash_attention.flash_attention(q, k, v, causal=True)

@@ -371,6 +371,68 @@ def _flash_bwd_rule(causal, scale, block_q, block_kv, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8))
+def _flash_lse(q, k, v, causal, scale, q_offset, kv_offset, block_q,
+               block_kv):
+    """(o, lse)-returning variant with a differentiable backward — the
+    ring-attention train path needs gradients to flow through BOTH
+    outputs (the logsumexp participates in the cross-chunk merge).
+
+    Forward: pallas kernel. Backward: einsum recompute in fp32 including
+    the dlse term (d lse_i/d s_ij = p_ij, so ds picks up dlse_i - the
+    same shape as the rowsum(do*o) correction). O(Cq x Ckv) scores live
+    during backward — fine at ring chunk sizes; a pallas backward ring
+    is the planned optimization."""
+    return _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, scale, q_offset, kv_offset,
+                        block_q, block_kv):
+    o, lse = _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                  kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+    return (o, lse), (q, k, v, o, lse, q_offset, kv_offset)
+
+
+def _flash_lse_bwd_rule(causal, scale, block_q, block_kv, res, cots):
+    del block_q, block_kv
+    do, dlse = cots
+    q, k, v, o, lse, q_offset, kv_offset = res
+    b, hq, sq, d = q.shape
+    hkv, skv = k.shape[1], k.shape[2]
+    group = hq // hkv
+
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    dlsef = dlse.astype(jnp.float32)
+
+    qg = qf.reshape(b, hkv, group, sq, d)
+    s = jnp.einsum('bkgqd,bksd->bkgqs', qg, kf) * scale
+    if causal:
+        rows = q_offset + jnp.arange(sq)[:, None]
+        cols = kv_offset + jnp.arange(skv)[None, :]
+        s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse.reshape(b, hkv, group, sq)[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+
+    dog = dof.reshape(b, hkv, group, sq, d)
+    dv = jnp.einsum('bkgqs,bkgqd->bksd', p, dog)
+    dp = jnp.einsum('bkgqd,bksd->bkgqs', dog, vf)
+    delta = (jnp.sum(dof * of, axis=-1)          # rowsum(do*o)
+             - dlsef).reshape(b, hkv, group, sq)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum('bkgqs,bksd->bkgqd', ds, kf).reshape(b, hq, sq, d)
+    dk = jnp.einsum('bkgqs,bkgqd->bksd', ds, qg)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None)
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def reference_attention_hsd(q, k, v, *, causal: bool = True,
                             scale: Optional[float] = None,
                             q_offset=0, kv_offset=0):
@@ -407,9 +469,10 @@ def flash_attention_hsd(q: jax.Array, k: jax.Array, v: jax.Array, *,
                         block_q: int = DEFAULT_BLOCK_Q,
                         block_kv: int = DEFAULT_BLOCK_KV,
                         return_lse: bool = False):
-    """[B, H, S, D]-layout entry. `return_lse=True` skips the custom VJP
-    (used by ring attention, which does its own chunk merging). Off-TPU
-    (no Mosaic compiler) this transparently uses the einsum reference."""
+    """[B, H, S, D]-layout entry. `return_lse=True` returns (o, lse)
+    with gradients flowing through both (ring attention merges chunks by
+    lse). Off-TPU (no Mosaic compiler) this transparently uses the
+    einsum reference."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if jax.default_backend() == 'cpu':
@@ -418,8 +481,8 @@ def flash_attention_hsd(q: jax.Array, k: jax.Array, v: jax.Array, *,
             kv_offset=kv_offset)
         return (o, lse) if return_lse else o
     if return_lse:
-        return _fwd(q, k, v, causal=causal, scale=scale, q_offset=q_offset,
-                    kv_offset=kv_offset, block_q=block_q, block_kv=block_kv)
+        return _flash_lse(q, k, v, causal, scale, q_offset, kv_offset,
+                          block_q, block_kv)
     return _flash(q, k, v, causal, scale, q_offset, kv_offset,
                   block_q, block_kv)
 

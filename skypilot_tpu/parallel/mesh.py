@@ -23,7 +23,9 @@ Canonical axes (any may be size 1):
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -84,6 +86,38 @@ def default_mesh_shape(num_devices: int,
             f'tp*sp*ep*dp={claimed}')
     fsdp = num_devices // claimed
     return MeshShape(dp=dp or 1, fsdp=fsdp, sp=sp, tp=tp, ep=ep)
+
+
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Context manager exposing the mesh to model code during tracing
+    (train/trainer.py wraps the step body in this so ops that need
+    explicit manual sharding — ring attention — can find the mesh
+    without threading it through every model signature)."""
+    prev = getattr(_tls, 'mesh', None)
+    _tls.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _tls.mesh = prev
+
+
+def current_mesh() -> Optional[Mesh]:
+    return getattr(_tls, 'mesh', None)
+
+
+def compat_shard_map(f, **kw):
+    """shard_map across jax versions (check_vma vs check_rep spelling)."""
+    try:
+        from jax import shard_map as sm  # jax >= 0.8
+        return sm(f, **kw)
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as sm
+        kw['check_rep'] = kw.pop('check_vma', True)
+        return sm(f, **kw)
 
 
 def shard(x: jax.Array, spec) -> jax.Array:

@@ -30,20 +30,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _jax_shard_map  # jax >= 0.8
-
-    def _shard_map(f, **kw):
-        return _jax_shard_map(f, **kw)
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _exp_shard_map
-
-    def _shard_map(f, **kw):
-        # Older shard_map spells the replication check 'check_rep'.
-        kw['check_rep'] = kw.pop('check_vma', True)
-        return _exp_shard_map(f, **kw)
-
 from skypilot_tpu.models import llama
+from skypilot_tpu.parallel.mesh import compat_shard_map as _shard_map
 from skypilot_tpu.parallel.mesh import shard as _shard
 
 

@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import mesh as mesh_lib
 
 
 @dataclasses.dataclass
@@ -160,8 +161,9 @@ def make_train_step(cfg: Any, mesh: Mesh,
                 return cross_entropy_loss(logits, targets)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params,
-                                                  batch['tokens'])
+        with mesh_lib.use_mesh(mesh):   # visible to ops during tracing
+            loss, grads = jax.value_and_grad(loss_fn)(state.params,
+                                                      batch['tokens'])
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
