@@ -367,6 +367,21 @@ def serve_up(entrypoint, service_name, yes):
     serve_core.up(task, service_name=service_name)
 
 
+@serve.command(name='update')
+@click.argument('service_name')
+@click.argument('entrypoint')
+@click.option('--yes', '-y', is_flag=True)
+def serve_update(service_name, entrypoint, yes):
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu import Task
+    task = Task.from_yaml(entrypoint)
+    if not yes:
+        click.confirm(f'Update service {service_name!r}?', abort=True,
+                      default=True)
+    version = serve_core.update(service_name, task)
+    print(f'Service {service_name!r} rolling to version {version}.')
+
+
 @serve.command(name='status')
 @click.argument('service_name', required=False)
 def serve_status(service_name):

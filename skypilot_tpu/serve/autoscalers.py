@@ -20,6 +20,9 @@ QPS_WINDOW_SECONDS = 60.0
 @dataclasses.dataclass
 class ScalingDecision:
     target_num_replicas: int
+    # Spot/on-demand split (None => homogeneous, type per spec.use_spot).
+    target_spot: Optional[int] = None
+    target_ondemand: Optional[int] = None
 
 
 class RequestRateAutoscaler:
@@ -42,11 +45,16 @@ class RequestRateAutoscaler:
         recent = [t for t in request_timestamps if t >= cutoff]
         return len(recent) / self.qps_window_seconds
 
-    def evaluate(self, request_timestamps: List[float]) -> ScalingDecision:
+    def evaluate(self, request_timestamps: List[float],
+                 num_ready_spot: Optional[int] = None) -> ScalingDecision:
+        del num_ready_spot
+        return ScalingDecision(self._hysteresis_target(request_timestamps))
+
+    def _hysteresis_target(self, request_timestamps: List[float]) -> int:
         spec = self.spec
         if spec.target_qps_per_replica is None:
             self.target = spec.min_replicas
-            return ScalingDecision(self.target)
+            return self.target
         qps = self.current_qps(request_timestamps)
         desired = max(spec.min_replicas,
                       min(spec.max_replicas,
@@ -66,4 +74,36 @@ class RequestRateAutoscaler:
         else:
             self._upscale_counter = 0
             self._downscale_counter = 0
-        return ScalingDecision(self.target)
+        return self.target
+
+
+class FallbackRequestRateAutoscaler(RequestRateAutoscaler):
+    """Spot + on-demand mix (reference: sky/serve/autoscalers.py:546).
+
+    Of the hysteresis target N: `base_ondemand_fallback_replicas` always
+    run on-demand; the rest run on spot. With dynamic_ondemand_fallback,
+    every spot replica that is not yet READY (preempted / provisioning)
+    is temporarily backed by an extra on-demand replica, which drains as
+    spot capacity comes back."""
+
+    def evaluate(self, request_timestamps: List[float],
+                 num_ready_spot: Optional[int] = None) -> ScalingDecision:
+        spec = self.spec
+        total = self._hysteresis_target(request_timestamps)
+        base_od = min(spec.base_ondemand_fallback_replicas, total)
+        spot = total - base_od
+        ondemand = base_od
+        if spec.dynamic_ondemand_fallback and num_ready_spot is not None:
+            ondemand += max(0, spot - num_ready_spot)
+        return ScalingDecision(target_num_replicas=spot + ondemand,
+                               target_spot=spot,
+                               target_ondemand=ondemand)
+
+
+def make_autoscaler(spec: SkyServiceSpec,
+                    tick_seconds: float = 10.0) -> RequestRateAutoscaler:
+    if spec.use_spot and (spec.base_ondemand_fallback_replicas
+                          or spec.dynamic_ondemand_fallback):
+        return FallbackRequestRateAutoscaler(spec,
+                                             tick_seconds=tick_seconds)
+    return RequestRateAutoscaler(spec, tick_seconds=tick_seconds)

@@ -33,7 +33,8 @@ def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
     task.to_yaml(task_yaml)
     log_path = str(svc_dir / 'controller.log')
 
-    state.add_service(name, json.dumps(task.service.to_yaml_config()))
+    state.add_service(name, json.dumps(task.service.to_yaml_config()),
+                      task_yaml=task_yaml)
     with open(log_path, 'ab') as log_f:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.serve.service',
@@ -43,6 +44,33 @@ def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
     logger.info(f'Service {name!r} starting (controller pid {proc.pid}); '
                 f'endpoint will be 127.0.0.1:{task.service.port}.')
     return name
+
+
+def update(service_name: str, task: task_lib.Task) -> int:
+    """Roll the service to a new task/spec (reference: sky serve update
+    — serve/core.py update). The controller picks the version bump up on
+    its next tick and replaces replicas blue-green: old-version replicas
+    keep serving until the new version reaches the target ready count.
+    Returns the new version."""
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task YAML needs a `service:` section for serve update.')
+    svc = state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} not found.')
+    svc_dir = config_lib.home_dir() / 'serve' / service_name
+    svc_dir.mkdir(parents=True, exist_ok=True)
+    version_guess = (svc['version'] or 1) + 1
+    task_yaml = str(svc_dir / f'task.v{version_guess}.yaml')
+    task.to_yaml(task_yaml)
+    version = state.bump_version(
+        service_name, json.dumps(task.service.to_yaml_config()),
+        task_yaml)
+    logger.info(f'Service {service_name!r} update to version {version} '
+                'submitted; replicas roll over on the next controller '
+                'tick.')
+    return version
 
 
 def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:

@@ -24,10 +24,13 @@ PROBE_FAILURE_THRESHOLD = 3
 
 class ReplicaInfo:
     def __init__(self, replica_id: int, cluster_name: str,
-                 port: int) -> None:
+                 port: int, is_spot: bool = False,
+                 version: int = 1) -> None:
         self.replica_id = replica_id
         self.cluster_name = cluster_name
         self.port = port
+        self.is_spot = is_spot
+        self.version = version
         self.status = state.ReplicaStatus.PROVISIONING
         self.endpoint: Optional[str] = None
         self.consecutive_failures = 0
@@ -46,10 +49,24 @@ class ReplicaManager:
         self.service_name = service_name
         self.task = task
         self.spec = spec
+        self.version = 1
         self.replicas: Dict[int, ReplicaInfo] = {}
         self._next_id = 1
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
+
+    def begin_update(self, task: task_lib.Task, spec: SkyServiceSpec,
+                     version: int) -> None:
+        """`skyt serve update`: future launches use the new task/spec;
+        rollout_tick replaces old-version replicas blue-green."""
+        self.task = task
+        self.spec = spec
+        self.version = version
+
+    @property
+    def updating(self) -> bool:
+        return any(i.version < self.version
+                   for i in self.replicas.values())
 
     # -------------------------------------------------------------- #
 
@@ -60,13 +77,16 @@ class ReplicaManager:
             return self.spec.port + replica_id
         return self.spec.port
 
-    def scale_up(self) -> None:
+    def scale_up(self, use_spot: Optional[bool] = None) -> None:
         with self._lock:
             replica_id = self._next_id
             self._next_id += 1
             cluster = f'skyt-serve-{self.service_name}-{replica_id}'
             info = ReplicaInfo(replica_id, cluster,
-                               self._replica_port(replica_id))
+                               self._replica_port(replica_id),
+                               is_spot=(self.spec.use_spot
+                                        if use_spot is None else use_spot),
+                               version=self.version)
             self.replicas[replica_id] = info
         state.upsert_replica(self.service_name, replica_id, cluster,
                              state.ReplicaStatus.PROVISIONING, None)
@@ -76,7 +96,6 @@ class ReplicaManager:
         self._threads.append(t)
 
     def _launch_replica(self, info: ReplicaInfo) -> None:
-        import copy
         replica_task = task_lib.Task(
             name=f'{self.service_name}-r{info.replica_id}',
             run=self.task.run, setup=self.task.setup,
@@ -86,7 +105,8 @@ class ReplicaManager:
             workdir=self.task.workdir,
             file_mounts=dict(self.task.file_mounts),
         )
-        replica_task.resources = copy.copy(self.task.resources)
+        replica_task.resources = self.task.resources.copy(
+            use_spot=info.is_spot)
         try:
             _, handle = execution.launch(replica_task,
                                          cluster_name=info.cluster_name,
@@ -184,5 +204,57 @@ class ReplicaManager:
 
     @property
     def num_alive(self) -> int:
-        return len([i for i in self.replicas.values()
-                    if i.status != state.ReplicaStatus.FAILED])
+        return len(self._alive())
+
+    def _alive(self, *, is_spot: Optional[bool] = None
+               ) -> List[ReplicaInfo]:
+        out = [i for i in self.replicas.values()
+               if i.status != state.ReplicaStatus.FAILED]
+        if is_spot is not None:
+            out = [i for i in out if i.is_spot == is_spot]
+        return out
+
+    def num_ready_spot(self) -> int:
+        return len([i for i in self.ready_replicas() if i.is_spot])
+
+    def reconcile(self, decision) -> None:
+        """Converge replica counts to the decision. Mixed decisions
+        (target_spot/target_ondemand) reconcile each pool; homogeneous
+        ones reconcile the total."""
+        if decision.target_spot is None:
+            self._reconcile_pool(None, decision.target_num_replicas)
+        else:
+            self._reconcile_pool(True, decision.target_spot)
+            self._reconcile_pool(False, decision.target_ondemand)
+
+    def _reconcile_pool(self, is_spot: Optional[bool],
+                        target: int) -> None:
+        alive = self._alive(is_spot=is_spot)
+        if len(alive) < target:
+            for _ in range(target - len(alive)):
+                self.scale_up(use_spot=is_spot)
+        elif len(alive) > target:
+            # Shed not-ready first, then the newest READY replicas —
+            # keep the oldest, warmed ones.
+            candidates = sorted(
+                alive,
+                key=lambda i: (i.status == state.ReplicaStatus.READY,
+                               -i.replica_id))
+            for info in candidates[:len(alive) - target]:
+                self.scale_down(info.replica_id)
+
+    def rollout_tick(self, target: int) -> None:
+        """Blue-green step for `serve update`: keep old-version replicas
+        serving until the new version reaches the target ready count,
+        then drain the old ones."""
+        new = [i for i in self._alive() if i.version == self.version]
+        old = [i for i in self._alive() if i.version < self.version]
+        if len(new) < target:
+            for _ in range(target - len(new)):
+                self.scale_up()
+            return
+        ready_new = [i for i in new
+                     if i.status == state.ReplicaStatus.READY]
+        if len(ready_new) >= max(1, target):
+            for info in old:
+                self.scale_down(info.replica_id)

@@ -38,8 +38,9 @@ def main() -> None:
     assert spec is not None, 'task has no service section'
 
     manager = replica_managers.ReplicaManager(name, task, spec)
-    autoscaler = autoscalers.RequestRateAutoscaler(
-        spec, tick_seconds=TICK_SECONDS)
+    autoscaler = autoscalers.make_autoscaler(spec,
+                                             tick_seconds=TICK_SECONDS)
+    current_version = 1
     lb = lb_lib.LoadBalancer(spec.port, manager.ready_replicas,
                              policy=spec.load_balancing_policy)
 
@@ -70,24 +71,28 @@ def main() -> None:
     while True:
         time.sleep(TICK_SECONDS)
         try:
+            # `serve update` path: pick up a new version from the DB,
+            # swap task/spec/autoscaler, then roll replicas blue-green.
+            svc = state.get_service(name)
+            if (svc is not None and svc['version'] > current_version
+                    and svc['task_yaml']):
+                logger.info(f'updating {name!r} to version '
+                            f"{svc['version']}")
+                new_task = task_lib.Task.from_yaml(svc['task_yaml'])
+                manager.begin_update(new_task, new_task.service,
+                                     svc['version'])
+                autoscaler = autoscalers.make_autoscaler(
+                    new_task.service, tick_seconds=TICK_SECONDS)
+                current_version = svc['version']
+
             manager.probe_all()
-            decision = autoscaler.evaluate(lb.request_timestamps)
-            alive = manager.num_alive
-            if decision.target_num_replicas > alive:
-                for _ in range(decision.target_num_replicas - alive):
-                    manager.scale_up()
-            elif decision.target_num_replicas < alive:
-                # Shed not-ready first, then the newest (highest-id) READY
-                # replicas — keep the oldest, warmed ones. FAILED replicas
-                # aren't in the alive count, so they don't consume excess.
-                candidates = sorted(
-                    (i for i in manager.replicas.values()
-                     if i.status != state.ReplicaStatus.FAILED),
-                    key=lambda i: (i.status == state.ReplicaStatus.READY,
-                                   -i.replica_id))
-                excess = alive - decision.target_num_replicas
-                for info in candidates[:excess]:
-                    manager.scale_down(info.replica_id)
+            decision = autoscaler.evaluate(
+                lb.request_timestamps,
+                num_ready_spot=manager.num_ready_spot())
+            if manager.updating:
+                manager.rollout_tick(decision.target_num_replicas)
+            else:
+                manager.reconcile(decision)
             ready = len(manager.ready_replicas())
             status = (state.ServiceStatus.READY if ready > 0
                       else state.ServiceStatus.REPLICA_INIT)

@@ -21,6 +21,12 @@ class SkyServiceSpec:
     downscale_delay_seconds: int = 1200
     port: int = 8080
     load_balancing_policy: str = 'least_load'
+    # Spot replica mix (reference: FallbackRequestRateAutoscaler,
+    # sky/serve/autoscalers.py:546): serve from preemptible TPU with an
+    # on-demand safety net.
+    use_spot: bool = False
+    base_ondemand_fallback_replicas: int = 0
+    dynamic_ondemand_fallback: bool = False
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'SkyServiceSpec':
@@ -48,6 +54,15 @@ class SkyServiceSpec:
                 policy.get('upscale_delay_seconds', 300))
             spec.downscale_delay_seconds = int(
                 policy.get('downscale_delay_seconds', 1200))
+            spec.use_spot = bool(policy.get('use_spot', False))
+            spec.base_ondemand_fallback_replicas = int(
+                policy.get('base_ondemand_fallback_replicas', 0))
+            spec.dynamic_ondemand_fallback = bool(
+                policy.get('dynamic_ondemand_fallback', False))
+            if (spec.base_ondemand_fallback_replicas
+                    or spec.dynamic_ondemand_fallback) and not spec.use_spot:
+                raise exceptions.InvalidTaskError(
+                    'on-demand fallback requires use_spot: true')
         elif config.get('replicas') is not None:
             spec.min_replicas = int(config['replicas'])
         if config.get('ports') is not None:
@@ -91,4 +106,10 @@ class SkyServiceSpec:
         if self.target_qps_per_replica is not None:
             cfg['replica_policy']['target_qps_per_replica'] = \
                 self.target_qps_per_replica
+        if self.use_spot:
+            cfg['replica_policy']['use_spot'] = True
+            cfg['replica_policy']['base_ondemand_fallback_replicas'] = \
+                self.base_ondemand_fallback_replicas
+            cfg['replica_policy']['dynamic_ondemand_fallback'] = \
+                self.dynamic_ondemand_fallback
         return cfg

@@ -38,7 +38,9 @@ def _conn() -> sqlite3.Connection:
             controller_pid INTEGER,
             endpoint TEXT,
             spec_json TEXT,
-            created_at REAL);
+            created_at REAL,
+            version INTEGER DEFAULT 1,
+            task_yaml TEXT);
         CREATE TABLE IF NOT EXISTS replicas (
             service_name TEXT,
             replica_id INTEGER,
@@ -50,14 +52,26 @@ def _conn() -> sqlite3.Connection:
     return conn
 
 
-def add_service(name: str, spec_json: str) -> None:
+def add_service(name: str, spec_json: str,
+                task_yaml: Optional[str] = None) -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO services (name, status,'
-            ' controller_pid, endpoint, spec_json, created_at)'
-            ' VALUES (?,?,?,?,?,?)',
+            ' controller_pid, endpoint, spec_json, created_at,'
+            ' version, task_yaml) VALUES (?,?,?,?,?,?,1,?)',
             (name, ServiceStatus.CONTROLLER_INIT.value, None, None,
-             spec_json, time.time()))
+             spec_json, time.time(), task_yaml))
+
+
+def bump_version(name: str, spec_json: str, task_yaml: str) -> int:
+    """`serve update`: record the new task/spec; returns new version."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE services SET version=version+1, spec_json=?, '
+            'task_yaml=? WHERE name=?', (spec_json, task_yaml, name))
+        row = conn.execute('SELECT version FROM services WHERE name=?',
+                           (name,)).fetchone()
+        return row[0]
 
 
 def set_service(name: str, *, status: Optional[ServiceStatus] = None,
@@ -78,12 +92,13 @@ def set_service(name: str, *, status: Optional[ServiceStatus] = None,
 def get_service(name: str) -> Optional[Dict[str, Any]]:
     row = _conn().execute(
         'SELECT name, status, controller_pid, endpoint, spec_json,'
-        ' created_at FROM services WHERE name=?', (name,)).fetchone()
+        ' created_at, version, task_yaml FROM services WHERE name=?',
+        (name,)).fetchone()
     if row is None:
         return None
     return {'name': row[0], 'status': row[1], 'controller_pid': row[2],
             'endpoint': row[3], 'spec': json.loads(row[4]),
-            'created_at': row[5]}
+            'created_at': row[5], 'version': row[6], 'task_yaml': row[7]}
 
 
 def get_services() -> List[Dict[str, Any]]:

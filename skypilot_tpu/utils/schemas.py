@@ -40,6 +40,7 @@ REPLICA_POLICY_FIELDS: Dict[str, Any] = {
     'target_qps_per_replica': (int, float),
     'upscale_delay_seconds': int,
     'downscale_delay_seconds': int,
+    'use_spot': bool,
     'base_ondemand_fallback_replicas': int,
     'dynamic_ondemand_fallback': bool,
 }

@@ -1,0 +1,144 @@
+"""Spot-fallback autoscaler + `serve update` rolling replace tests
+(reference: FallbackRequestRateAutoscaler sky/serve/autoscalers.py:546;
+sky serve update)."""
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.serve import autoscalers, core as serve_core, state
+from skypilot_tpu.serve.service_spec import SkyServiceSpec
+
+
+def _spec(**policy):
+    base = {'min_replicas': 2, 'max_replicas': 4,
+            'target_qps_per_replica': 1,
+            'upscale_delay_seconds': 0, 'downscale_delay_seconds': 0,
+            'use_spot': True}
+    base.update(policy)
+    return SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/', 'replica_policy': base, 'ports': 9000})
+
+
+def test_factory_picks_fallback():
+    spec = _spec(base_ondemand_fallback_replicas=1)
+    assert isinstance(autoscalers.make_autoscaler(spec),
+                      autoscalers.FallbackRequestRateAutoscaler)
+    plain = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': '/',
+        'replica_policy': {'min_replicas': 1}, 'ports': 9000})
+    assert not isinstance(autoscalers.make_autoscaler(plain),
+                          autoscalers.FallbackRequestRateAutoscaler)
+
+
+def test_base_ondemand_split():
+    spec = _spec(base_ondemand_fallback_replicas=1)
+    a = autoscalers.FallbackRequestRateAutoscaler(spec, tick_seconds=1)
+    d = a.evaluate([], num_ready_spot=1)     # qps 0 -> min 2 replicas
+    assert d.target_spot == 1 and d.target_ondemand == 1
+    assert d.target_num_replicas == 2
+
+
+def test_dynamic_fallback_backfills_preempted_spot():
+    spec = _spec(dynamic_ondemand_fallback=True)
+    a = autoscalers.FallbackRequestRateAutoscaler(spec, tick_seconds=1)
+    # Want 2 spot; none ready (preempted) -> 2 extra on-demand.
+    d = a.evaluate([], num_ready_spot=0)
+    assert d.target_spot == 2 and d.target_ondemand == 2
+    # Spot came back -> fallback drains.
+    d = a.evaluate([], num_ready_spot=2)
+    assert d.target_spot == 2 and d.target_ondemand == 0
+
+
+def test_fallback_spec_requires_use_spot():
+    with pytest.raises(Exception, match='use_spot'):
+        SkyServiceSpec.from_yaml_config({
+            'readiness_probe': '/',
+            'replica_policy': {'min_replicas': 1,
+                               'base_ondemand_fallback_replicas': 1},
+            'ports': 9000})
+
+
+# ------------------------- serve update e2e ------------------------- #
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _serve_task(port, banner):
+    run = ('python3 -c "\n'
+           'import http.server, os\n'
+           'class H(http.server.BaseHTTPRequestHandler):\n'
+           f'    def do_GET(self):\n'
+           f'        body = \'{banner}\'.encode()\n'
+           '        self.send_response(200)\n'
+           '        self.send_header(\'Content-Length\', str(len(body)))\n'
+           '        self.end_headers()\n'
+           '        self.wfile.write(body)\n'
+           '    def log_message(self, *a): pass\n'
+           'http.server.HTTPServer((\'127.0.0.1\', '
+           'int(os.environ[\'SKYT_REPLICA_PORT\'])), H).serve_forever()\n'
+           '"')
+    t = sky.Task(name='svc', run=run)
+    t.set_resources(sky.Resources.new(accelerators='tpu-v5e-1',
+                                      cloud='fake'))
+    t.service = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 20},
+        'replica_policy': {'min_replicas': 1,
+                           'upscale_delay_seconds': 1,
+                           'downscale_delay_seconds': 2},
+        'ports': port,
+    })
+    return t
+
+
+def _wait(predicate, timeout=90, msg='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.5)
+    raise TimeoutError(f'timed out waiting for {msg}')
+
+
+def test_serve_update_rolls_replicas(monkeypatch):
+    monkeypatch.setenv('SKYT_SERVE_TICK_SECONDS', '0.5')
+    port = _free_port()
+    name = serve_core.up(_serve_task(port, 'v1-banner'),
+                         service_name='upd1')
+
+    def _ready():
+        svcs = serve_core.status(name)
+        return svcs and any(r['status'] == 'READY'
+                            for r in svcs[0]['replicas'])
+    _wait(_ready, msg='v1 ready')
+    body = urllib.request.urlopen(f'http://127.0.0.1:{port}/',
+                                  timeout=10).read().decode()
+    assert body == 'v1-banner'
+
+    version = serve_core.update(name, _serve_task(port, 'v2-banner'))
+    assert version == 2
+
+    def _v2_served():
+        try:
+            return urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/',
+                timeout=5).read().decode() == 'v2-banner'
+        except Exception:  # noqa: BLE001 — transient during the roll
+            return False
+    _wait(_v2_served, msg='v2 served')
+
+    # Old replica drained: exactly one replica remains.
+    def _one_replica():
+        svcs = serve_core.status(name)
+        reps = [r for r in svcs[0]['replicas']
+                if r['status'] in ('READY', 'STARTING', 'NOT_READY')]
+        return len(reps) == 1
+    _wait(_one_replica, msg='old replica drained')
+    serve_core.down(name)
