@@ -139,9 +139,13 @@ def update_benchmark(benchmark: str) -> List[Dict[str, Any]]:
         cost = (price / 3600.0 * parsed['seconds_per_step']
                 if price else None)
         status = row['status']
-        job_status = backend.get_job_status(handle, row['job_id'] or 1)
-        if job_status in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
-            status = 'FINISHED' if job_status == 'SUCCEEDED' else job_status
+        # No recorded job id → leave status unchanged rather than guessing
+        # job 1 (which may be an unrelated job on a reused cluster).
+        if row['job_id'] is not None:
+            job_status = backend.get_job_status(handle, row['job_id'])
+            if job_status in ('SUCCEEDED', 'FAILED', 'CANCELLED'):
+                status = ('FINISHED' if job_status == 'SUCCEEDED'
+                          else job_status)
         state.update_result(
             benchmark, row['cluster'], status=status,
             num_steps=parsed['num_steps'],

@@ -415,8 +415,12 @@ def _flash_lse_bwd_rule(causal, scale, block_q, block_kv, res, cots):
         rows = q_offset + jnp.arange(sq)[:, None]
         cols = kv_offset + jnp.arange(skv)[None, :]
         s = jnp.where((rows >= cols)[None, None, None], s, _NEG_INF)
-    p = jnp.exp(s - lse.reshape(b, hkv, group, sq)[..., None])
-    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    # _NEG_INF is a large finite sentinel, so isfinite() would not catch
+    # masked entries; match the forward kernel's threshold guard. Also zero
+    # fully-masked rows (lse == _NEG_INF would make p = exp(0) = 1 row-wide).
+    lse_g = lse.reshape(b, hkv, group, sq)[..., None]
+    p = jnp.where((s > _NEG_INF / 2) & (lse_g > _NEG_INF / 2),
+                  jnp.exp(s - lse_g), 0.0)
 
     dog = dof.reshape(b, hkv, group, sq, d)
     dv = jnp.einsum('bkgqs,bkgqd->bksd', p, dog)

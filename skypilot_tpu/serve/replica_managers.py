@@ -243,15 +243,34 @@ class ReplicaManager:
             for info in candidates[:len(alive) - target]:
                 self.scale_down(info.replica_id)
 
-    def rollout_tick(self, target: int) -> None:
+    def rollout_tick(self, decision) -> None:
         """Blue-green step for `serve update`: keep old-version replicas
         serving until the new version reaches the target ready count,
-        then drain the old ones."""
+        then drain the old ones. Honors the autoscaler's spot/on-demand
+        split so a fallback service's on-demand safety net is re-created
+        on-demand, not as spot."""
+        target = decision.target_num_replicas
+        # Drain FAILED old-version replicas immediately: _alive() excludes
+        # them, so without this they would sit in self.replicas forever,
+        # `updating` would never go False, and the autoscaler's reconcile
+        # path would be permanently disabled after the update.
+        for info in list(self.replicas.values()):
+            if (info.version < self.version
+                    and info.status == state.ReplicaStatus.FAILED):
+                self.scale_down(info.replica_id)
         new = [i for i in self._alive() if i.version == self.version]
         old = [i for i in self._alive() if i.version < self.version]
         if len(new) < target:
-            for _ in range(target - len(new)):
-                self.scale_up()
+            if decision.target_spot is None:
+                for _ in range(target - len(new)):
+                    self.scale_up()
+            else:
+                new_spot = len([i for i in new if i.is_spot])
+                new_od = len(new) - new_spot
+                for _ in range(max(0, decision.target_spot - new_spot)):
+                    self.scale_up(use_spot=True)
+                for _ in range(max(0, decision.target_ondemand - new_od)):
+                    self.scale_up(use_spot=False)
             return
         ready_new = [i for i in new
                      if i.status == state.ReplicaStatus.READY]

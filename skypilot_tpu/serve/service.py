@@ -90,7 +90,7 @@ def main() -> None:
                 lb.request_timestamps,
                 num_ready_spot=manager.num_ready_spot())
             if manager.updating:
-                manager.rollout_tick(decision.target_num_replicas)
+                manager.rollout_tick(decision)
             else:
                 manager.reconcile(decision)
             ready = len(manager.ready_replicas())

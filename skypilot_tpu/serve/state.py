@@ -49,6 +49,15 @@ def _conn() -> sqlite3.Connection:
             endpoint TEXT,
             PRIMARY KEY (service_name, replica_id));
     """)
+    # Backfill columns for DBs created before they existed (mirrors
+    # jobs/state.py): CREATE TABLE IF NOT EXISTS does not alter an
+    # existing table.
+    for ddl in ('ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1',
+                'ALTER TABLE services ADD COLUMN task_yaml TEXT'):
+        try:
+            conn.execute(ddl)
+        except sqlite3.OperationalError:
+            pass  # Column already exists.
     return conn
 
 

@@ -96,3 +96,48 @@ def test_reference_offsets():
                                                 q_offset=0, kv_offset=64)
     assert np.all(np.asarray(o_fut) == 0)
     assert np.all(np.asarray(lse_fut) <= -1e29)
+
+
+def test_flash_lse_bwd_fully_masked_rows():
+    """Regression: the custom backward of the (o, lse) flash path must
+    produce ZERO grads for a fully-masked chunk even when the (do, dlse)
+    cotangents are nonzero. _NEG_INF is a finite sentinel, so a naive
+    isfinite() guard lets p = exp(lse-lse) = 1 leak through row-wide."""
+    b, h, s, d = 1, 2, 64, 128
+    q, k, v = _rand(20, (b, h, s, d)), _rand(21, (b, h, s, d)), \
+        _rand(22, (b, h, s, d))
+    scale = d ** -0.5
+    # Future chunk: every (row, col) pair masked.
+    o, lse = fa.reference_attention_hsd(q, k, v, causal=True,
+                                        q_offset=0, kv_offset=s)
+    res = (q, k, v, o, lse, 0, s)
+    cots = (jnp.ones_like(o), jnp.ones_like(lse))
+    dq, dk, dv, _, _ = fa._flash_lse_bwd_rule(True, scale, 128, 128,
+                                              res, cots)
+    assert np.all(np.asarray(dq) == 0)
+    assert np.all(np.asarray(dk) == 0)
+    assert np.all(np.asarray(dv) == 0)
+
+
+def test_flash_lse_bwd_matches_autodiff():
+    """The hand-written (o, lse) backward equals autodiff through the
+    einsum reference on a normal causal chunk, including the dlse term."""
+    b, h, s, d = 1, 2, 64, 128
+    q, k, v = _rand(23, (b, h, s, d)), _rand(24, (b, h, s, d)), \
+        _rand(25, (b, h, s, d))
+    scale = d ** -0.5
+
+    def loss(q, k, v):
+        o, lse = fa.reference_attention_hsd(q, k, v, causal=True,
+                                            scale=scale)
+        return jnp.sum(o.astype(jnp.float32)) + 0.3 * jnp.sum(lse)
+
+    g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    o, lse = fa.reference_attention_hsd(q, k, v, causal=True, scale=scale)
+    res = (q, k, v, o, lse, 0, 0)
+    cots = (jnp.ones_like(o), jnp.full_like(lse, 0.3))
+    dq, dk, dv, _, _ = fa._flash_lse_bwd_rule(True, scale, 128, 128,
+                                              res, cots)
+    for a, b_ in zip((dq, dk, dv), g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-3)

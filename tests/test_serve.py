@@ -134,3 +134,27 @@ def test_service_spec_validation():
             'replica_policy': {'min_replicas': 1, 'max_replicas': 3}})
     spec = SkyServiceSpec.from_yaml_config({'replicas': 2})
     assert spec.min_replicas == spec.max_replicas == 2
+
+
+def test_state_db_migration(tmp_path, monkeypatch):
+    """A serve.db created before the version/task_yaml columns existed
+    must be ALTER-TABLE-backfilled, not crash every serve command."""
+    import sqlite3
+    from skypilot_tpu import config as config_lib
+    home = config_lib.home_dir()
+    home.mkdir(parents=True, exist_ok=True)
+    conn = sqlite3.connect(str(home / 'serve.db'))
+    conn.executescript("""
+        CREATE TABLE services (
+            name TEXT PRIMARY KEY, status TEXT, controller_pid INTEGER,
+            endpoint TEXT, spec_json TEXT, created_at REAL);
+        INSERT INTO services VALUES
+            ('old-svc', 'READY', NULL, '1.2.3.4:8080', '{}', 0.0);
+    """)
+    conn.commit()
+    conn.close()
+    svc = state.get_service('old-svc')
+    assert svc is not None
+    assert svc['version'] == 1
+    assert svc['task_yaml'] is None
+    assert state.get_services()[0]['name'] == 'old-svc'
