@@ -14,6 +14,7 @@ at a token shard directory for real runs.
 from __future__ import annotations
 
 import argparse
+import os
 import time
 
 import jax
@@ -38,6 +39,10 @@ def parse_args():
     p.add_argument('--sp', type=int, default=1)
     p.add_argument('--tp', type=int, default=1)
     p.add_argument('--ep', type=int, default=1)
+    p.add_argument('--ckpt-dir', default=os.environ.get('SKYT_CKPT_DIR'),
+                   help='Checkpoint dir (a MOUNT-mode bucket path for '
+                        'spot recovery). Restores latest on start.')
+    p.add_argument('--ckpt-every', type=int, default=50)
     return p.parse_args()
 
 
@@ -69,6 +74,21 @@ def main():
     state, shardings, opt = trainer.init_train_state(cfg, mesh, model=model)
     step = trainer.make_train_step(cfg, mesh, opt, shardings, model=model)
 
+    # Spot-recovery resume: restore the latest checkpoint (if any) from
+    # the bucket-mounted --ckpt-dir; a preempted-and-relaunched managed
+    # job continues from step N instead of step 0.
+    ckpt = None
+    start_step = 0
+    if args.ckpt_dir:
+        from skypilot_tpu.train import checkpoints
+        ckpt = checkpoints.CheckpointManager(args.ckpt_dir)
+        latest, restored = ckpt.restore_latest(state)
+        if latest is not None:
+            state = restored
+            start_step = latest + 1
+            print(f'resumed from checkpoint step {latest} '
+                  f'({args.ckpt_dir})')
+
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(
         key, (args.batch_size, args.seq_len + 1), 0, cfg.vocab_size)
@@ -77,14 +97,21 @@ def main():
     callbacks.init(total_steps=args.steps)
     tokens_per_step = args.batch_size * args.seq_len
     t0 = time.time()
-    for i in range(args.steps):
+    done = 0
+    for i in range(start_step, args.steps):
         state, metrics = step(state, batch)
         jax.block_until_ready(metrics['loss'])
         callbacks.on_step_end()
-        if i in (0, args.steps - 1) or i % 10 == 0:
+        done += 1
+        if i in (start_step, args.steps - 1) or i % 10 == 0:
             dt = time.time() - t0
             print(f'step {i} loss {float(metrics["loss"]):.4f} '
-                  f'({tokens_per_step * (i + 1) / dt:.0f} tok/s)')
+                  f'({tokens_per_step * done / dt:.0f} tok/s)')
+        if ckpt is not None and ((i + 1) % args.ckpt_every == 0
+                                 or i == args.steps - 1):
+            ckpt.save(i, state)
+    if ckpt is not None:
+        ckpt.close()
     callbacks.close()
 
 

@@ -28,4 +28,7 @@ def __getattr__(name):
                 'cancel', 'tail_logs', 'cost_report'):
         from skypilot_tpu import core
         return getattr(core, name)
+    if name in ('Storage', 'StorageMode', 'StoreType'):
+        from skypilot_tpu.data import storage
+        return getattr(storage, name)
     raise AttributeError(f'module {__name__!r} has no attribute {name!r}')

@@ -174,6 +174,30 @@ class CloudTpuBackend:
         subprocess_utils.run_in_parallel(_sync, handle.all_runners())
 
     @timeline.event
+    def sync_storage(self, handle: ClusterHandle,
+                     storage_mounts: Dict[str, Any]) -> None:
+        """Create/upload each bucket client-side, then COPY or MOUNT it on
+        every host. Reference splits this across task.sync_storage_mounts
+        (sky/task.py:951) and _execute_storage_mounts
+        (cloud_vm_ray_backend.py:4827); ours executes the store's own
+        COPY/MOUNT command per host — uniform across store types, so the
+        fake cloud exercises the same code path as GCS."""
+        if not storage_mounts:
+            return
+        from skypilot_tpu.data import storage as storage_lib
+        runners = handle.all_runners()
+        for dst, stor in storage_mounts.items():
+            store = stor.create_and_upload()
+            if stor.mode == storage_lib.StorageMode.COPY:
+                cmd = store.sync_down_cmd(dst)
+            else:
+                cmd = store.mount_cmd(dst)
+            logger.info(f'Storage {store.uri} -> {dst} '
+                        f'({stor.mode.value}, {len(runners)} hosts)')
+            subprocess_utils.run_in_parallel(
+                lambda r, c=cmd: r.run(c, check=True), runners)
+
+    @timeline.event
     def sync_file_mounts(self, handle: ClusterHandle,
                          file_mounts: Dict[str, str]) -> None:
         """dst-on-cluster <- src (local path or gs:// URI), all hosts

@@ -58,6 +58,7 @@ def launch_benchmark(task: task_lib.Task,
             name=f'{task.name or "bench"}-{idx}',
             run=task.run, setup=task.setup, num_nodes=task.num_nodes,
             workdir=task.workdir, file_mounts=task.file_mounts,
+            storage_mounts=task.storage_mounts,
             envs={**(task.envs or {}),
                   callbacks.ENV_LOG_DIR: f'{_REMOTE_LOG_DIR}/{benchmark}'})
         bench_task.set_resources(res)

@@ -6,6 +6,8 @@ from __future__ import annotations
 
 import shlex
 
+from skypilot_tpu.cloud_stores import _quote_dest
+
 GCSFUSE_VERSION = '2.5.1'
 
 MOUNT_BINARY_INSTALL = (
@@ -29,15 +31,15 @@ def get_gcsfuse_mount_cmd(bucket_name: str, mount_path: str,
              '--rename-dir-limit 10000']
     if readonly:
         flags.append('-o ro')
-    return (f'mkdir -p {shlex.quote(mount_path)} && '
+    return (f'mkdir -p {_quote_dest(mount_path)} && '
             f'gcsfuse {" ".join(flags)} '
-            f'{shlex.quote(bucket_name)} {shlex.quote(mount_path)}')
+            f'{shlex.quote(bucket_name)} {_quote_dest(mount_path)}')
 
 
 def get_mount_check_cmd(mount_path: str) -> str:
-    return f'mountpoint -q {shlex.quote(mount_path)}'
+    return f'mountpoint -q {_quote_dest(mount_path)}'
 
 
 def get_umount_cmd(mount_path: str) -> str:
-    return (f'fusermount -u {shlex.quote(mount_path)} || '
-            f'sudo umount -l {shlex.quote(mount_path)}')
+    return (f'fusermount -u {_quote_dest(mount_path)} || '
+            f'sudo umount -l {_quote_dest(mount_path)}')

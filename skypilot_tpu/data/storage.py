@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 import os
+import shlex
 import shutil
 import subprocess
 from typing import Any, Dict, List, Optional
@@ -20,6 +21,7 @@ from skypilot_tpu import config as config_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import sky_logging
+from skypilot_tpu.cloud_stores import _quote_dest
 
 logger = sky_logging.init_logger(__name__)
 
@@ -118,12 +120,27 @@ class GcsStore(AbstractStore):
                 bucket.blob(rel).upload_from_filename(full)
 
     def sync_down_cmd(self, dst: str) -> str:
-        return (f'mkdir -p {dst} && '
-                f'gsutil -m rsync -r gs://{self.name} {dst}')
+        dst_q = _quote_dest(dst)
+        return (f'mkdir -p {dst_q} && '
+                f'gsutil -m rsync -r gs://{self.name} {dst_q}')
 
     def mount_cmd(self, mount_path: str) -> str:
         from skypilot_tpu.data import mounting_utils
-        return mounting_utils.get_gcsfuse_mount_cmd(self.name, mount_path)
+        # Install gcsfuse if absent; idempotent on relaunch onto a live
+        # cluster — but only if the path is mounted from THIS bucket
+        # (gcsfuse mounts appear in /proc/mounts as "<bucket> <path>
+        # fuse..."): a stale mount of a different bucket is unmounted
+        # first, so editing `name:` in the YAML takes effect instead of
+        # silently writing to the old bucket.
+        mount = mounting_utils.get_gcsfuse_mount_cmd(self.name, mount_path)
+        check = mounting_utils.get_mount_check_cmd(mount_path)
+        umount = mounting_utils.get_umount_cmd(mount_path)
+        target = _quote_dest(mount_path)
+        same_bucket = (f'grep -qs "^{self.name} $(readlink -f {target}) '
+                       f'fuse" /proc/mounts')
+        return (f'{mounting_utils.MOUNT_BINARY_INSTALL} && '
+                f'{{ ! {check} || {same_bucket} || {umount}; }} && '
+                f'({check} || ({mount}))')
 
     @property
     def uri(self) -> str:
@@ -156,10 +173,24 @@ class LocalStore(AbstractStore):
             shutil.copytree(local_path, self._dir(), dirs_exist_ok=True)
 
     def sync_down_cmd(self, dst: str) -> str:
-        return f'mkdir -p {dst} && cp -a {self._dir()}/. {dst}/'
+        dst_q = _quote_dest(dst)
+        return (f'mkdir -p {dst_q} && '
+                f'cp -a {shlex.quote(self._dir())}/. {dst_q}/')
 
     def mount_cmd(self, mount_path: str) -> str:
-        return self.sync_down_cmd(mount_path)
+        # Symlink the mount path onto the bucket directory: writes from
+        # the job land in the "bucket" immediately and survive cluster
+        # teardown — the same observable semantics as a FUSE mount,
+        # without FUSE (fake-cloud hosts share the client filesystem).
+        target = _quote_dest(mount_path)
+        bucket = shlex.quote(self._dir())
+        return (f'mkdir -p {bucket} "$(dirname {target})" && '
+                f'if [ -d {target} ] && [ ! -L {target} ]; then '
+                f'rmdir {target} 2>/dev/null || {{ '
+                f'echo "skyt: mount path {mount_path} exists and is not '
+                f'empty (a previous COPY-mode sync?); remove it before '
+                f'MOUNTing a bucket there." >&2; exit 1; }}; fi && '
+                f'ln -sfn {bucket} {target}')
 
     @property
     def uri(self) -> str:
@@ -186,8 +217,18 @@ class Storage:
                          config: Dict[str, Any]) -> 'Storage':
         if isinstance(config, str):
             config = {'source': config}
-        store_type = StoreType(config.get('store', 'GCS').upper())
-        mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        try:
+            store_type = StoreType(config.get('store', 'GCS').upper())
+        except ValueError as e:
+            raise exceptions.StorageSpecError(
+                f"storage {name!r}: unknown store {config['store']!r}; "
+                f'allowed: {[t.value for t in StoreType]}') from e
+        try:
+            mode = StorageMode(config.get('mode', 'MOUNT').upper())
+        except ValueError as e:
+            raise exceptions.StorageSpecError(
+                f"storage {name!r}: unknown mode {config['mode']!r}; "
+                f'allowed: {[m.value for m in StorageMode]}') from e
         return cls(name=config.get('name', name),
                    source=config.get('source'),
                    store_type=store_type, mode=mode,

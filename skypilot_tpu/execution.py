@@ -2,6 +2,7 @@
 
 Reference: sky/execution.py (642 LoC; Stage enum :31-41, _execute :95,
 launch :369). Stages: OPTIMIZE -> PROVISION -> SYNC_WORKDIR ->
+SYNC_STORAGE(buckets created/uploaded then COPY/MOUNT per host) ->
 SYNC_FILE_MOUNTS -> SETUP(part of job) -> PRE_EXEC(autostop) -> EXEC ->
 DOWN(optional).
 """
@@ -28,6 +29,7 @@ class Stage(enum.Enum):
     OPTIMIZE = enum.auto()
     PROVISION = enum.auto()
     SYNC_WORKDIR = enum.auto()
+    SYNC_STORAGE = enum.auto()
     SYNC_FILE_MOUNTS = enum.auto()
     PRE_EXEC = enum.auto()
     EXEC = enum.auto()
@@ -110,6 +112,9 @@ def _execute(entrypoint: Union[task_lib.Task, dag_lib.Dag],
                     f'{handle.cluster_name}...')
         backend.sync_workdir(handle, task.workdir)
 
+    if Stage.SYNC_STORAGE in stages and task.storage_mounts:
+        backend.sync_storage(handle, task.storage_mounts)
+
     if Stage.SYNC_FILE_MOUNTS in stages and task.file_mounts:
         backend.sync_file_mounts(handle, task.file_mounts)
 
@@ -145,7 +150,8 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
     managed-jobs recovery after a preemption).
     """
     stages = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
-              Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC, Stage.EXEC]
+              Stage.SYNC_STORAGE, Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC,
+              Stage.EXEC]
     if down:
         stages.append(Stage.DOWN)
     return _execute(task, cluster_name, stages, dryrun=dryrun,
@@ -162,5 +168,6 @@ def exec(task: Union[task_lib.Task, dag_lib.Dag],  # pylint: disable=redefined-b
     """Fast path onto an existing cluster: sync + run, no provision
     (reference: sky.exec, execution.py end; stages [SYNC_WORKDIR, EXEC])."""
     return _execute(task, cluster_name,
-                    [Stage.SYNC_WORKDIR, Stage.SYNC_FILE_MOUNTS, Stage.EXEC],
+                    [Stage.SYNC_WORKDIR, Stage.SYNC_STORAGE,
+                     Stage.SYNC_FILE_MOUNTS, Stage.EXEC],
                     detach_run=detach_run)

@@ -78,6 +78,10 @@ class JobsController:
         """Returns True on success (reference: _run_one_task :116)."""
         cluster_name = self._cluster_name(task_idx)
         state.set_cluster_name(self.job_id, cluster_name)
+        # Stable across recoveries (SKYT_TASK_ID is per-submission), so
+        # recipes can key checkpoint paths on it and resume after
+        # preemption.
+        task.update_envs({'SKYT_MANAGED_JOB_ID': str(self.job_id)})
         max_restarts = int(os.environ.get(
             'SKYT_JOBS_MAX_RESTARTS_ON_ERRORS', '0'))
         strategy = recovery_strategy.StrategyExecutor.make(

@@ -104,6 +104,7 @@ class ReplicaManager:
                   'SKYT_REPLICA_ID': str(info.replica_id)},
             workdir=self.task.workdir,
             file_mounts=dict(self.task.file_mounts),
+            storage_mounts=dict(self.task.storage_mounts),
         )
         replica_task.resources = self.task.resources.copy(
             use_spot=info.is_spot)

@@ -3,8 +3,9 @@
 A Task is: optional `setup` script, a `run` command, `num_nodes` (where one
 "node" on TPU means one *slice* — a v5p-64 node is 8 hosts, and the gang
 executor runs one process per host), env vars, a workdir synced to every
-host, file mounts, a set of candidate Resources, and an optional service
-spec for serving.
+host, file mounts, storage mounts (buckets COPY'd or FUSE-MOUNTed on the
+cluster — dict-valued `file_mounts:` entries, reference sky/task.py:420-445),
+a set of candidate Resources, and an optional service spec for serving.
 """
 from __future__ import annotations
 
@@ -36,6 +37,7 @@ class Task:
         workdir: Optional[str] = None,
         num_nodes: int = 1,
         file_mounts: Optional[Dict[str, str]] = None,
+        storage_mounts: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.name = name
         self.setup = setup
@@ -46,6 +48,9 @@ class Task:
         self.num_nodes = num_nodes
         # dst path on cluster -> src (local path or storage URI like gs://..)
         self.file_mounts: Dict[str, str] = dict(file_mounts or {})
+        # mount path on cluster -> data.storage.Storage (bucket spec).
+        # Populated from dict-valued file_mounts entries in YAML.
+        self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
         self.resources: resources_lib.Resources = resources_lib.Resources()
         self.service: Optional[Any] = None   # serve.SkyServiceSpec
         self.best_resources = None           # filled by the optimizer
@@ -95,6 +100,23 @@ class Task:
                 f'env_overrides / --env.')
         envs = {k: str(v) for k, v in raw_envs.items()}
 
+        # Split file_mounts: str values are plain copies; dict values are
+        # storage (bucket) specs (reference parses the same union at
+        # sky/task.py:420-445).
+        copy_mounts: Dict[str, str] = {}
+        storage_mounts: Dict[str, Any] = {}
+        for dst, src in (config.get('file_mounts') or {}).items():
+            if isinstance(src, str):
+                copy_mounts[dst] = src
+            else:  # dict, guaranteed by validate_task_config
+                from skypilot_tpu.data import storage as storage_lib
+                if not src.get('name'):
+                    raise exceptions.InvalidTaskError(
+                        f'file_mounts.{dst}: storage specs need an '
+                        f"explicit 'name:' (the bucket name).")
+                storage_mounts[dst] = storage_lib.Storage.from_yaml_config(
+                    src['name'], src)
+
         task = cls(
             name=config.get('name'),
             setup=config.get('setup'),
@@ -102,7 +124,8 @@ class Task:
             envs=envs,
             workdir=config.get('workdir'),
             num_nodes=int(config.get('num_nodes') or 1),
-            file_mounts=config.get('file_mounts'),
+            file_mounts=copy_mounts,
+            storage_mounts=storage_mounts,
         )
         task.resources = resources_lib.Resources.from_yaml_config(
             config.get('resources'))
@@ -140,8 +163,18 @@ class Task:
             cfg['num_nodes'] = self.num_nodes
         if self.workdir:
             cfg['workdir'] = self.workdir
-        if self.file_mounts:
-            cfg['file_mounts'] = dict(self.file_mounts)
+        if self.file_mounts or self.storage_mounts:
+            fm: Dict[str, Any] = dict(self.file_mounts)
+            for dst, stor in self.storage_mounts.items():
+                spec: Dict[str, Any] = {'name': stor.name,
+                                        'store': stor.store_type.value,
+                                        'mode': stor.mode.value}
+                if stor.source:
+                    spec['source'] = stor.source
+                if not stor.persistent:
+                    spec['persistent'] = False
+                fm[dst] = spec
+            cfg['file_mounts'] = fm
         if self.setup:
             cfg['setup'] = self.setup
         if isinstance(self.run, str):
@@ -170,6 +203,15 @@ class Task:
 
     def set_file_mounts(self, mounts: Dict[str, str]) -> 'Task':
         self.file_mounts = dict(mounts)
+        return self
+
+    def set_storage_mounts(self, mounts: Dict[str, Any]) -> 'Task':
+        """mount-path -> data.storage.Storage (reference: task.py:812)."""
+        self.storage_mounts = dict(mounts)
+        return self
+
+    def update_storage_mounts(self, mounts: Dict[str, Any]) -> 'Task':
+        self.storage_mounts.update(mounts)
         return self
 
     # ------------------------------------------------------------------ #

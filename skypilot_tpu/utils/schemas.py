@@ -34,6 +34,15 @@ SERVICE_FIELDS: Dict[str, Any] = {
     'load_balancing_policy': str,
 }
 
+# Dict-valued file_mounts entries are storage (bucket) specs.
+STORAGE_FIELDS: Dict[str, Any] = {
+    'name': str,
+    'source': str,
+    'store': str,
+    'mode': str,
+    'persistent': bool,
+}
+
 REPLICA_POLICY_FIELDS: Dict[str, Any] = {
     'min_replicas': int,
     'max_replicas': int,
@@ -80,6 +89,13 @@ def validate_task_config(config: Dict[str, Any]) -> None:
     if 'num_nodes' in config and config['num_nodes'] is not None:
         if config['num_nodes'] < 1:
             raise exceptions.InvalidTaskError('task.num_nodes must be >= 1')
+    for dst, src in (config.get('file_mounts') or {}).items():
+        if isinstance(src, dict):
+            check_fields(src, STORAGE_FIELDS, f'task.file_mounts.{dst}')
+        elif not isinstance(src, str):
+            raise exceptions.InvalidTaskError(
+                f'task.file_mounts.{dst}: expected a path/URI string or a '
+                f'storage spec mapping, got {type(src).__name__}')
 
 
 def validate_service_config(config: Dict[str, Any]) -> None:

@@ -1,0 +1,81 @@
+"""Checkpoint-resume: orbax round trip on a sharded train state, and the
+train_llm.py recipe actually resuming from the saved step (VERDICT r1 #3)."""
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import checkpoints, trainer
+
+EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(checkpoints.__file__)))), 'examples')
+
+
+def test_checkpoint_roundtrip_sharded(tmp_path):
+    """Save a mesh-sharded TrainState, restore into a fresh state's
+    shardings, resume training — step counter and params carry over."""
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(fsdp=8))
+    cfg = llama.llama_tiny()
+    state, shardings, opt = trainer.init_train_state(cfg, mesh)
+    step = trainer.make_train_step(cfg, mesh, opt, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 65), 0,
+                                cfg.vocab_size)
+    batch = {'tokens': tokens}
+    for _ in range(2):
+        state, _ = step(state, batch)
+
+    mgr = checkpoints.CheckpointManager(str(tmp_path / 'ckpt'))
+    mgr.save(int(state.step), state)
+    mgr.close()
+    saved_params = jax.tree.map(np.asarray, state.params)
+
+    # "Relaunch": fresh manager + freshly initialized state as template.
+    state2, shardings2, opt2 = trainer.init_train_state(cfg, mesh, seed=7)
+    mgr2 = checkpoints.CheckpointManager(str(tmp_path / 'ckpt'))
+    latest, restored = mgr2.restore_latest(state2)
+    assert latest == 2
+    assert int(restored.step) == 2
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        restored.params, saved_params)
+    # Restored arrays landed in the template's shardings; the jitted step
+    # accepts them directly (resume without recompilation surprises).
+    restored, metrics = step(restored, batch)
+    assert int(restored.step) == 3
+    mgr2.close()
+
+
+def test_checkpoint_empty_dir(tmp_path):
+    mgr = checkpoints.CheckpointManager(str(tmp_path / 'none'))
+    step, state = mgr.restore_latest(template=None)
+    assert step is None and state is None
+    mgr.close()
+
+
+def test_train_llm_resumes(tmp_path):
+    """Run the recipe, then run it again pointed at the same ckpt dir —
+    the second run must RESUME (the managed-spot recovery contract)."""
+    ckpt_dir = str(tmp_path / 'ckpt')
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(EXAMPLES),
+               JAX_PLATFORMS='cpu')
+    base = [sys.executable, os.path.join(EXAMPLES, 'train_llm.py'),
+            '--model', 'llama-tiny', '--batch-size', '8',
+            '--seq-len', '128', '--ckpt-dir', ckpt_dir,
+            '--ckpt-every', '1']
+    first = subprocess.run(base + ['--steps', '2'], capture_output=True,
+                           text=True, timeout=300, env=env)
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert 'resumed' not in first.stdout
+
+    second = subprocess.run(base + ['--steps', '4'], capture_output=True,
+                            text=True, timeout=300, env=env)
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert 'resumed from checkpoint step 1' in second.stdout
+    # Only the remaining steps ran.
+    assert 'step 2 ' in second.stdout and 'step 0 ' not in second.stdout

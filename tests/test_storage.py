@@ -31,8 +31,10 @@ def test_storage_yaml_forms():
         'name': 'mybkt', 'store': 'gcs', 'mode': 'COPY'})
     assert s.store_type == storage.StoreType.GCS
     assert s.mode == storage.StorageMode.COPY
-    with pytest.raises(ValueError):
+    with pytest.raises(exceptions.StorageSpecError, match='s3'):
         storage.Storage.from_yaml_config('/d', {'store': 's3'})
+    with pytest.raises(exceptions.StorageSpecError, match='symlink'):
+        storage.Storage.from_yaml_config('/d', {'mode': 'symlink'})
 
 
 def test_missing_source_raises(tmp_path):
@@ -58,3 +60,148 @@ def test_single_file_source(tmp_path):
     os.system(store.sync_down_cmd(str(dst)))
     assert (dst / 'one.csv').read_text() == 'a,b'
     storage.delete_storage('filebkt')
+
+
+def test_task_yaml_storage_mounts_roundtrip(tmp_path):
+    """Dict-valued file_mounts entries parse into Task.storage_mounts and
+    survive the YAML round trip; bad specs raise typed errors."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import exceptions as exc
+    src = tmp_path / 'src'
+    src.mkdir()
+    cfg = {
+        'name': 'stor',
+        'run': 'true',
+        'file_mounts': {
+            '/plain': str(src),
+            '/data': {'name': 'bkt-a', 'store': 'LOCAL', 'mode': 'MOUNT',
+                      'source': str(src)},
+            '/copy': {'name': 'bkt-b', 'store': 'LOCAL', 'mode': 'COPY'},
+        },
+    }
+    task = sky.Task.from_yaml_config(cfg)
+    assert task.file_mounts == {'/plain': str(src)}
+    assert set(task.storage_mounts) == {'/data', '/copy'}
+    assert task.storage_mounts['/data'].mode == storage.StorageMode.MOUNT
+    assert task.storage_mounts['/copy'].store_type == storage.StoreType.LOCAL
+    out = task.to_yaml_config()
+    assert out['file_mounts']['/data'] == {
+        'name': 'bkt-a', 'store': 'LOCAL', 'mode': 'MOUNT',
+        'source': str(src)}
+    # Round trip parses back to the same storage mounts.
+    again = sky.Task.from_yaml_config(out)
+    assert set(again.storage_mounts) == {'/data', '/copy'}
+
+    with pytest.raises(exc.InvalidTaskError, match='name'):
+        sky.Task.from_yaml_config(
+            {'run': 'true', 'file_mounts': {'/d': {'mode': 'MOUNT'}}})
+    with pytest.raises(exc.InvalidTaskError, match='unknown field'):
+        sky.Task.from_yaml_config(
+            {'run': 'true',
+             'file_mounts': {'/d': {'name': 'b', 'modee': 'MOUNT'}}})
+    with pytest.raises(exc.InvalidTaskError, match='storage spec'):
+        sky.Task.from_yaml_config(
+            {'run': 'true', 'file_mounts': {'/d': 42}})
+
+
+def _wait_job(cluster, job_id, timeout=60):
+    import time
+    from skypilot_tpu import core
+    deadline = time.time() + timeout
+    status = None
+    while time.time() < deadline:
+        status = core.job_status(cluster, job_id)
+        if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP', 'CANCELLED'):
+            return status
+        time.sleep(0.2)
+    raise TimeoutError(f'job {job_id} still {status}')
+
+
+def test_mount_mode_e2e_fake_cloud(tmp_path):
+    """VERDICT round-1 'done' criterion: a MOUNT-mode bucket is writable
+    from inside a fake-cloud job, contents visible via the storage verbs,
+    and survives cluster teardown."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import core
+    src = tmp_path / 'dataset'
+    src.mkdir()
+    (src / 'input.txt').write_text('payload')
+
+    task = sky.Task(
+        name='stormount',
+        run=('cat ~/data/input.txt && '
+             'echo "written-by-job" > ~/data/ckpt.txt'),
+    )
+    task.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                         cloud='fake'))
+    task.set_storage_mounts({'~/data': storage.Storage(
+        name='mntbkt', source=str(src),
+        store_type=storage.StoreType.LOCAL,
+        mode=storage.StorageMode.MOUNT)})
+    job_id, _ = sky.launch(task, cluster_name='stor1',
+                           quiet_optimizer=True)
+    assert _wait_job('stor1', job_id) == 'SUCCEEDED'
+    # The job's write landed in the bucket itself (MOUNT semantics).
+    bucket_dir = storage.LocalStore('mntbkt')._dir()
+    assert os.path.isfile(os.path.join(bucket_dir, 'ckpt.txt'))
+    # Tracked by the storage verbs.
+    assert 'mntbkt' in [r['name'] for r in global_user_state.get_storage()]
+    # Survives teardown.
+    core.down('stor1')
+    assert os.path.isfile(os.path.join(bucket_dir, 'ckpt.txt'))
+    storage.delete_storage('mntbkt')
+
+
+def test_copy_mode_e2e_fake_cloud(tmp_path):
+    """COPY mode materializes bucket contents on the hosts; writes stay
+    on-cluster (NOT in the bucket)."""
+    import skypilot_tpu as sky
+    from skypilot_tpu import core
+    src = tmp_path / 'dataset'
+    src.mkdir()
+    (src / 'input.txt').write_text('payload')
+
+    task = sky.Task(
+        name='storcopy',
+        run=('cat ~/data/input.txt && '
+             'echo scratch > ~/data/scratch.txt'),
+    )
+    task.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                         cloud='fake'))
+    task.set_storage_mounts({'~/data': storage.Storage(
+        name='cpybkt', source=str(src),
+        store_type=storage.StoreType.LOCAL,
+        mode=storage.StorageMode.COPY)})
+    job_id, _ = sky.launch(task, cluster_name='stor2',
+                           quiet_optimizer=True)
+    assert _wait_job('stor2', job_id) == 'SUCCEEDED'
+    bucket_dir = storage.LocalStore('cpybkt')._dir()
+    assert not os.path.exists(os.path.join(bucket_dir, 'scratch.txt'))
+    core.down('stor2')
+    storage.delete_storage('cpybkt')
+
+
+def test_gcs_mount_cmd_bucket_aware_idempotency():
+    """Relaunch must remount when the YAML's bucket changed: the command
+    unmounts a mount of a DIFFERENT bucket before mounting ours."""
+    s = storage.GcsStore('bkt-b')
+    cmd = s.mount_cmd('~/ckpt')
+    assert 'gcsfuse' in cmd
+    assert '/proc/mounts' in cmd and '^bkt-b ' in cmd
+    assert 'fusermount -u' in cmd
+
+
+def test_local_mount_cmd_nonempty_dir_message(tmp_path):
+    """COPY->MOUNT switch on a live cluster fails with an actionable
+    message, not a bare rmdir error."""
+    import subprocess
+    s = storage.LocalStore('msgbkt')
+    s.create()
+    mnt = tmp_path / 'mnt'
+    mnt.mkdir()
+    (mnt / 'leftover.txt').write_text('x')
+    proc = subprocess.run(['bash', '-c', s.mount_cmd(str(mnt))],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert 'remove it before MOUNTing' in proc.stderr
+    s.delete()
