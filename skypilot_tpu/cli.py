@@ -309,38 +309,52 @@ def jobs():
 @click.argument('entrypoint')
 @click.option('--name', '-n', default=None)
 @click.option('--env', multiple=True)
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local',
+              help="'vm' launches the controller onto a framework-"
+                   'provisioned cluster (survives this machine).')
 @click.option('--yes', '-y', is_flag=True)
-def jobs_launch(entrypoint, name, env, yes):
+def jobs_launch(entrypoint, name, env, controller, yes):
     from skypilot_tpu.jobs import core as jobs_core
     task = _load_task(entrypoint, env, {})
     if name:
         task.name = name
-    jobs_core.launch(task, name=name)
+    jobs_core.launch(task, name=name, controller=controller)
 
 
 @jobs.command(name='queue')
 def jobs_queue():
     from skypilot_tpu.jobs import core as jobs_core
     rows = [[str(j['job_id']), j['name'], j['status'],
-             str(j.get('recoveries', 0)), _fmt_age(j.get('submitted_at'))]
-            for j in jobs_core.queue()]
-    print(_table(['ID', 'NAME', 'STATUS', 'RECOVERIES', 'SUBMITTED'],
-                 rows))
+             str(j.get('recoveries', 0)), _fmt_age(j.get('submitted_at')),
+             j.get('controller', 'local')]
+            for j in jobs_core.queue_all()]
+    print(_table(['ID', 'NAME', 'STATUS', 'RECOVERIES', 'SUBMITTED',
+                  'CONTROLLER'], rows))
 
 
 @jobs.command(name='cancel')
 @click.argument('job_id', type=int)
-def jobs_cancel(job_id):
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local')
+def jobs_cancel(job_id, controller):
     from skypilot_tpu.jobs import core as jobs_core
-    jobs_core.cancel(job_id)
+    if controller == 'vm':
+        jobs_core.vm_cancel(job_id)
+    else:
+        jobs_core.cancel(job_id)
     print(f'Managed job {job_id} cancel requested.')
 
 
 @jobs.command(name='logs')
 @click.argument('job_id', type=int)
 @click.option('--follow/--no-follow', default=True)
-def jobs_logs(job_id, follow):
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local')
+def jobs_logs(job_id, follow, controller):
     from skypilot_tpu.jobs import core as jobs_core
+    if controller == 'vm':
+        sys.exit(jobs_core.vm_tail_logs(job_id, follow=follow))
     sys.exit(jobs_core.tail_logs(job_id, follow=follow))
 
 
@@ -359,26 +373,35 @@ def serve():
 @serve.command(name='up')
 @click.argument('entrypoint')
 @click.option('--service-name', '-n', default=None)
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local',
+              help="'vm' runs the controller + load balancer on a "
+                   'framework-provisioned cluster.')
 @click.option('--yes', '-y', is_flag=True)
-def serve_up(entrypoint, service_name, yes):
+def serve_up(entrypoint, service_name, controller, yes):
     from skypilot_tpu.serve import core as serve_core
     from skypilot_tpu import Task
     task = Task.from_yaml(entrypoint)
-    serve_core.up(task, service_name=service_name)
+    serve_core.up(task, service_name=service_name, controller=controller)
 
 
 @serve.command(name='update')
 @click.argument('service_name')
 @click.argument('entrypoint')
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local')
 @click.option('--yes', '-y', is_flag=True)
-def serve_update(service_name, entrypoint, yes):
+def serve_update(service_name, entrypoint, controller, yes):
     from skypilot_tpu.serve import core as serve_core
     from skypilot_tpu import Task
     task = Task.from_yaml(entrypoint)
     if not yes:
         click.confirm(f'Update service {service_name!r}?', abort=True,
                       default=True)
-    version = serve_core.update(service_name, task)
+    if controller == 'vm':
+        version = serve_core.vm_update(service_name, task)
+    else:
+        version = serve_core.update(service_name, task)
     print(f'Service {service_name!r} rolling to version {version}.')
 
 
@@ -386,16 +409,21 @@ def serve_update(service_name, entrypoint, yes):
 @click.argument('service_name', required=False)
 def serve_status(service_name):
     from skypilot_tpu.serve import core as serve_core
-    for svc in serve_core.status(service_name):
+    for svc in serve_core.status_all(service_name):
         print(svc)
 
 
 @serve.command(name='down')
 @click.argument('service_name')
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local')
 @click.option('--yes', '-y', is_flag=True)
-def serve_down(service_name, yes):
+def serve_down(service_name, controller, yes):
     from skypilot_tpu.serve import core as serve_core
-    serve_core.down(service_name)
+    if controller == 'vm':
+        serve_core.vm_down(service_name)
+    else:
+        serve_core.down(service_name)
     print(f'Service {service_name!r} torn down.')
 
 

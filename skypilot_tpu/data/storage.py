@@ -53,6 +53,16 @@ class AbstractStore:
         raise NotImplementedError
 
     def upload(self, local_path: str) -> None:
+        """Upload to the bucket root."""
+        if os.path.isfile(local_path):
+            self.upload_to(local_path, os.path.basename(local_path))
+        else:
+            self.upload_to(local_path, '')
+
+    def upload_to(self, local_path: str, subpath: str) -> None:
+        """Upload under a sub-prefix ('' = bucket root; for files the
+        subpath names the destination object). The controller-VM mount
+        translation packs many sources into one bucket this way."""
         raise NotImplementedError
 
     def sync_down_cmd(self, dst: str) -> str:
@@ -96,28 +106,28 @@ class GcsStore(AbstractStore):
         if bucket.exists():
             bucket.delete(force=True)
 
-    def upload(self, local_path: str) -> None:
-        is_file = os.path.isfile(local_path)
+    def upload_to(self, local_path: str, subpath: str) -> None:
+        uri = f'gs://{self.name}/{subpath}'.rstrip('/')
         # gsutil does parallel composite uploads; prefer it when present.
         if shutil.which('gsutil'):
-            if is_file:
-                subprocess.run(['gsutil', 'cp', local_path,
-                                f'gs://{self.name}/'], check=True)
+            if os.path.isfile(local_path):
+                subprocess.run(['gsutil', 'cp', local_path, uri],
+                               check=True)
             else:
                 subprocess.run(['gsutil', '-m', 'rsync', '-r', local_path,
-                                f'gs://{self.name}'], check=True)
+                                uri], check=True)
             return
         client = self._client()
         bucket = client.bucket(self.name)
-        if is_file:
-            bucket.blob(os.path.basename(local_path)) \
-                .upload_from_filename(local_path)
+        if os.path.isfile(local_path):
+            bucket.blob(subpath).upload_from_filename(local_path)
             return
         for root, _, files in os.walk(local_path):
             for fname in files:
                 full = os.path.join(root, fname)
                 rel = os.path.relpath(full, local_path)
-                bucket.blob(rel).upload_from_filename(full)
+                key = f'{subpath}/{rel}' if subpath else rel
+                bucket.blob(key).upload_from_filename(full)
 
     def sync_down_cmd(self, dst: str) -> str:
         dst_q = _quote_dest(dst)
@@ -165,12 +175,14 @@ class LocalStore(AbstractStore):
     def delete(self) -> None:
         shutil.rmtree(self._dir(), ignore_errors=True)
 
-    def upload(self, local_path: str) -> None:
+    def upload_to(self, local_path: str, subpath: str) -> None:
         self.create()
+        dest = os.path.join(self._dir(), subpath)
         if os.path.isfile(local_path):
-            shutil.copy2(local_path, self._dir())
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            shutil.copy2(local_path, dest)
         else:
-            shutil.copytree(local_path, self._dir(), dirs_exist_ok=True)
+            shutil.copytree(local_path, dest, dirs_exist_ok=True)
 
     def sync_down_cmd(self, dst: str) -> str:
         dst_q = _quote_dest(dst)

@@ -212,6 +212,12 @@ class JobsController:
                     self._down(record['cluster_name'])
                 state.set_status(self.job_id,
                                  state.ManagedJobStatus.CANCELLED)
+            # Controller-VM mode: drop the intermediate bucket the
+            # client's local mounts were translated into (no-op for
+            # local-mode jobs without the marker env).
+            from skypilot_tpu.utils import controller_utils
+            for task in self.tasks:
+                controller_utils.cleanup_translation_bucket(task)
             # Release scheduler slots and admit the next WAITING job.
             scheduler.job_done(self.job_id)
 

@@ -1,10 +1,19 @@
 """Managed-jobs client API (reference: sky/jobs/core.py, 474 LoC).
 
-`launch` wraps the user dag into a controller process. Local-controller
-mode (default): the controller runs detached on this machine. With
-`controller='vm'` (GCP credentials required) the controller task recurses
-through sky.launch onto a GCE VM exactly like the reference's
-jobs-controller.yaml.j2 path — same module, different host.
+`launch` wraps the user dag into a controller process. Two modes:
+
+  * controller='local' (default): the controller runs detached on this
+    machine — honest single-user mode.
+  * controller='vm': the reference's signature recursion
+    (templates/jobs-controller.yaml.j2): a controller CLUSTER is
+    launched through sky.launch (GCE shape; fake-cloud host in tests),
+    the framework runtime lands on it via the provision path, local file
+    mounts are translated to an intermediate bucket
+    (controller_utils.translate_local_mounts_to_storage), and the job is
+    submitted over the jobs.rpc transport. The controller process, its
+    state DB, and every nested cluster launch live ON the VM — close the
+    laptop and the job keeps recovering/running. queue/cancel/logs reach
+    the VM over the same RPC.
 """
 from __future__ import annotations
 
@@ -31,27 +40,11 @@ def _jobs_dir() -> str:
     return str(d)
 
 
-def launch(task_or_dag, name: Optional[str] = None,
-           controller: str = 'local', detach: bool = True) -> int:
-    """Submit a managed job; returns the managed job id."""
-    from skypilot_tpu import dag as dag_lib
-    dag = dag_lib.to_dag(task_or_dag)
-    job_name = name or dag.name or (dag.tasks[0].name if dag.tasks
-                                    else None) or 'managed-job'
-    if controller != 'local':
-        raise exceptions.NotSupportedError(
-            'controller-VM mode needs the GCP provider; use '
-            "controller='local' for now.")
-
-    # Persist the dag as multi-doc YAML the controller re-reads (reference
-    # renders the user dag into the controller task the same way).
-    job_dir = os.path.join(_jobs_dir(), f'{int(time.time() * 1000)}')
-    os.makedirs(job_dir, exist_ok=True)
-    dag_yaml = os.path.join(job_dir, 'dag.yaml')
-    with open(dag_yaml, 'w') as f:
-        yaml.safe_dump_all([t.to_yaml_config() for t in dag.tasks], f,
-                           sort_keys=False)
-    log_path = os.path.join(job_dir, 'controller.log')
+def submit_dag_yaml(dag_yaml: str, job_name: str) -> int:
+    """Register an already-written dag YAML as a managed job in THIS
+    machine's jobs DB and let the admission scheduler start its
+    controller. Shared by local launch and the VM-side rpc.submit."""
+    log_path = os.path.join(os.path.dirname(dag_yaml), 'controller.log')
     job_id = state.add_job(job_name, dag_yaml, log_path)
     state.set_status(job_id, state.ManagedJobStatus.SUBMITTED)
 
@@ -66,6 +59,69 @@ def launch(task_or_dag, name: Optional[str] = None,
                     'frees.')
     else:
         logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
+    return job_id
+
+
+def _write_dag_yaml(dag) -> str:
+    """Persist the dag as multi-doc YAML the controller re-reads
+    (reference renders the user dag into the controller task the same
+    way)."""
+    job_dir = os.path.join(_jobs_dir(), f'{int(time.time() * 1000)}')
+    os.makedirs(job_dir, exist_ok=True)
+    dag_yaml = os.path.join(job_dir, 'dag.yaml')
+    with open(dag_yaml, 'w') as f:
+        yaml.safe_dump_all([t.to_yaml_config() for t in dag.tasks], f,
+                           sort_keys=False)
+    return dag_yaml
+
+
+def _launch_on_controller_vm(dag, job_name: str) -> int:
+    """Controller-VM recursion: provision/reuse the jobs controller
+    cluster, translate local mounts to a bucket, ship the dag YAML, and
+    submit over RPC. Returns the VM-side managed job id."""
+    import tempfile
+    from skypilot_tpu.utils import controller_utils
+    user_cloud = dag.tasks[0].resources.cloud if dag.tasks else None
+    handle = controller_utils.ensure_controller_cluster(
+        controller_utils.JOBS_CONTROLLER_CLUSTER, user_cloud)
+    bucket = controller_utils.unique_name(f'skyt-jobs-{job_name}')
+    for t in dag.tasks:
+        controller_utils.translate_local_mounts_to_storage(
+            t, bucket, user_cloud)
+    stage_name = controller_utils.unique_name(job_name)
+    with tempfile.TemporaryDirectory() as td:
+        dag_yaml = os.path.join(td, 'dag.yaml')
+        with open(dag_yaml, 'w') as f:
+            yaml.safe_dump_all([t.to_yaml_config() for t in dag.tasks], f,
+                               sort_keys=False)
+        remote_yaml = controller_utils.sync_up_for_rpc(
+            handle, dag_yaml, f'~/.skyt_managed/{stage_name}', 'dag.yaml')
+    result = controller_utils.rpc(
+        handle, 'skypilot_tpu.jobs.rpc',
+        ['submit', '--dag-yaml', remote_yaml, '--name', job_name])
+    job_id = result['job_id']
+    logger.info(f'Managed job {job_id} ({job_name!r}) submitted to '
+                f'controller cluster '
+                f'{controller_utils.JOBS_CONTROLLER_CLUSTER!r}.')
+    return job_id
+
+
+def launch(task_or_dag, name: Optional[str] = None,
+           controller: str = 'local', detach: bool = True) -> int:
+    """Submit a managed job; returns the managed job id."""
+    from skypilot_tpu import dag as dag_lib
+    dag = dag_lib.to_dag(task_or_dag)
+    job_name = name or dag.name or (dag.tasks[0].name if dag.tasks
+                                    else None) or 'managed-job'
+    if controller not in ('local', 'vm'):
+        raise exceptions.NotSupportedError(
+            f"controller must be 'local' or 'vm', got {controller!r}")
+    if controller == 'vm':
+        return _launch_on_controller_vm(dag, job_name)
+
+    from skypilot_tpu.jobs import scheduler
+    dag_yaml = _write_dag_yaml(dag)
+    job_id = submit_dag_yaml(dag_yaml, job_name)
     if not detach:
         last_reap = time.time()
         while True:
@@ -92,6 +148,56 @@ def queue() -> List[Dict[str, Any]]:
                     'cluster_name': j['cluster_name'],
                     'failure_reason': j['failure_reason']})
     return out
+
+
+def _vm_handle():
+    """Handle of the jobs controller cluster, or None when no VM-mode
+    jobs exist."""
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.controller_handle(
+        controller_utils.JOBS_CONTROLLER_CLUSTER)
+
+
+def queue_all() -> List[Dict[str, Any]]:
+    """Local jobs + (when a controller cluster exists) the VM's queue,
+    read over the jobs.rpc transport — NOT the local DB (reference: `sky
+    jobs queue` runs codegen on its controller VM)."""
+    out = [dict(j, controller='local') for j in queue()]
+    handle = _vm_handle()
+    if handle is not None:
+        from skypilot_tpu.utils import controller_utils
+        try:
+            vm_jobs = controller_utils.rpc(handle, 'skypilot_tpu.jobs.rpc',
+                                           ['queue'])
+            out.extend(dict(j, controller='vm') for j in vm_jobs)
+        except exceptions.SkyTpuError as e:
+            logger.warning(f'jobs controller cluster unreachable: {e}')
+    return out
+
+
+def vm_cancel(job_id: int) -> None:
+    """Cancel a VM-mode managed job on the controller cluster."""
+    from skypilot_tpu.utils import controller_utils
+    handle = _vm_handle()
+    if handle is None:
+        raise exceptions.JobNotFoundError(
+            'No jobs controller cluster is up.')
+    controller_utils.rpc(handle, 'skypilot_tpu.jobs.rpc',
+                         ['cancel', '--job-id', str(job_id)])
+
+
+def vm_tail_logs(job_id: int, follow: bool = True) -> int:
+    """Stream a VM-mode managed job's controller log to this tty."""
+    from skypilot_tpu.utils import controller_utils
+    handle = _vm_handle()
+    if handle is None:
+        raise exceptions.JobNotFoundError(
+            'No jobs controller cluster is up.')
+    args = ['logs', '--job-id', str(job_id)]
+    if not follow:
+        args.append('--no-follow')
+    return controller_utils.rpc(handle, 'skypilot_tpu.jobs.rpc', args,
+                                stream=True)
 
 
 def cancel(job_id: int) -> None:

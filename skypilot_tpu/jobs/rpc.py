@@ -1,0 +1,65 @@
+"""VM-side managed-jobs RPC: runs ON the controller cluster, invoked by
+the client over the cluster's CommandRunner (reference analog: the
+JobsCodeGen strings `sky jobs queue` runs over SSH on its controller VM,
+sky/jobs/utils.py — ours is a stable CLI instead of codegen'd snippets).
+
+Every subcommand prints exactly one `SKYT_JSON: <payload>` line (the same
+wire format as the cluster agent CLI). `submit` registers the job in the
+VM-LOCAL state DB and lets the admission scheduler spawn its controller
+process here — after that the client can disappear; the job lives on the
+controller VM.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _print_json(payload) -> None:
+    print('SKYT_JSON: ' + json.dumps(payload), flush=True)
+
+
+def main() -> int:
+    # The controller VM owns its own client-state universe: nested
+    # launches, the jobs DB, and the fake-cloud substrate (in tests) all
+    # live under the VM's HOME, never the submitting client's SKYT_HOME
+    # (which leaks through the runner env).
+    os.environ['SKYT_HOME'] = os.path.expanduser('~/.skyt')
+
+    parser = argparse.ArgumentParser(prog='skypilot_tpu.jobs.rpc')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    p_submit = sub.add_parser('submit')
+    p_submit.add_argument('--dag-yaml', required=True)
+    p_submit.add_argument('--name', required=True)
+    sub.add_parser('queue')
+    p_cancel = sub.add_parser('cancel')
+    p_cancel.add_argument('--job-id', type=int, required=True)
+    p_logs = sub.add_parser('logs')
+    p_logs.add_argument('--job-id', type=int, required=True)
+    p_logs.add_argument('--no-follow', action='store_true')
+    args = parser.parse_args()
+
+    from skypilot_tpu.jobs import core as jobs_core
+
+    if args.cmd == 'submit':
+        job_id = jobs_core.submit_dag_yaml(
+            os.path.expanduser(args.dag_yaml), args.name)
+        _print_json({'job_id': job_id})
+        return 0
+    if args.cmd == 'queue':
+        _print_json(jobs_core.queue())
+        return 0
+    if args.cmd == 'cancel':
+        jobs_core.cancel(args.job_id)
+        _print_json({'cancelled': args.job_id})
+        return 0
+    if args.cmd == 'logs':
+        return jobs_core.tail_logs(args.job_id,
+                                   follow=not args.no_follow)
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -18,21 +18,21 @@ from skypilot_tpu.serve import state
 logger = sky_logging.init_logger(__name__)
 
 
-def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
+def start_controller(name: str, task_yaml: str) -> int:
+    """Register the service and spawn its detached controller process on
+    THIS machine (the client in local mode; the controller VM when
+    invoked via serve.rpc). Returns the controller pid."""
+    task = task_lib.Task.from_yaml(task_yaml)
     if task.service is None:
         raise exceptions.InvalidTaskError(
             'Task YAML needs a `service:` section for serve up.')
-    name = service_name or task.name or 'service'
     if state.get_service(name) is not None:
         raise exceptions.SkyTpuError(
             f'Service {name!r} already exists; use a different name or '
             f'`skyt serve down {name}` first.')
     svc_dir = config_lib.home_dir() / 'serve' / name
     svc_dir.mkdir(parents=True, exist_ok=True)
-    task_yaml = str(svc_dir / 'task.yaml')
-    task.to_yaml(task_yaml)
     log_path = str(svc_dir / 'controller.log')
-
     state.add_service(name, json.dumps(task.service.to_yaml_config()),
                       task_yaml=task_yaml)
     with open(log_path, 'ab') as log_f:
@@ -41,8 +41,65 @@ def up(task: task_lib.Task, service_name: Optional[str] = None) -> str:
              '--service-name', name, '--task-yaml', task_yaml],
             stdout=log_f, stderr=subprocess.STDOUT,
             stdin=subprocess.DEVNULL, start_new_session=True)
-    logger.info(f'Service {name!r} starting (controller pid {proc.pid}); '
+    return proc.pid
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       controller: str = 'local') -> str:
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task YAML needs a `service:` section for serve up.')
+    name = service_name or task.name or 'service'
+    from skypilot_tpu.task import _VALID_NAME_RE
+    if not _VALID_NAME_RE.match(name):
+        raise exceptions.InvalidTaskError(
+            f'Invalid service name {name!r}.')
+    if controller == 'vm':
+        return _up_on_controller_vm(task, name)
+    if state.get_service(name) is not None:
+        # Check BEFORE writing: overwriting a live service's registered
+        # task.yaml would make a later controller restart use the wrong
+        # spec.
+        raise exceptions.SkyTpuError(
+            f'Service {name!r} already exists; use a different name or '
+            f'`skyt serve down {name}` first.')
+    svc_dir = config_lib.home_dir() / 'serve' / name
+    svc_dir.mkdir(parents=True, exist_ok=True)
+    task_yaml = str(svc_dir / 'task.yaml')
+    task.to_yaml(task_yaml)
+    pid = start_controller(name, task_yaml)
+    logger.info(f'Service {name!r} starting (controller pid {pid}); '
                 f'endpoint will be 127.0.0.1:{task.service.port}.')
+    return name
+
+
+def _up_on_controller_vm(task: task_lib.Task, name: str) -> str:
+    """Controller-VM recursion for serving (reference: serve controller
+    on its own cluster, sky/templates/sky-serve-controller.yaml.j2 +
+    serve/service.py:133 _start): the controller + load balancer run on
+    a framework-provisioned cluster; replicas are nested launches FROM
+    that cluster. The advertised endpoint is the controller VM's IP."""
+    import tempfile
+    from skypilot_tpu.utils import controller_utils
+    handle = controller_utils.ensure_controller_cluster(
+        controller_utils.SERVE_CONTROLLER_CLUSTER, task.resources.cloud)
+    bucket = controller_utils.unique_name(f'skyt-serve-{name}')
+    controller_utils.translate_local_mounts_to_storage(
+        task, bucket, task.resources.cloud)
+    with tempfile.TemporaryDirectory() as td:
+        local_yaml = os.path.join(td, 'task.yaml')
+        task.to_yaml(local_yaml)
+        remote_yaml = controller_utils.sync_up_for_rpc(
+            handle, local_yaml, f'~/.skyt_serve/{name}', 'task.yaml')
+    result = controller_utils.rpc(
+        handle, 'skypilot_tpu.serve.rpc',
+        ['up', '--service-name', name, '--task-yaml', remote_yaml])
+    head = handle.cluster_info.head_instance
+    ip = head.external_ip or head.internal_ip
+    logger.info(f"Service {name!r} starting on controller cluster "
+                f'{controller_utils.SERVE_CONTROLLER_CLUSTER!r} '
+                f'(controller pid {result["pid"]}); endpoint: '
+                f'{ip}:{task.service.port}')
     return name
 
 
@@ -86,6 +143,73 @@ def status(service_name: Optional[str] = None) -> List[Dict[str, Any]]:
     return out
 
 
+def _vm_handle():
+    from skypilot_tpu.utils import controller_utils
+    return controller_utils.controller_handle(
+        controller_utils.SERVE_CONTROLLER_CLUSTER)
+
+
+def status_all(service_name: Optional[str] = None
+               ) -> List[Dict[str, Any]]:
+    """Local services + the serve controller cluster's services (over
+    serve.rpc), endpoint rewritten to the controller VM's IP."""
+    out = [dict(s, controller='local') for s in status(service_name)]
+    handle = _vm_handle()
+    if handle is not None:
+        from skypilot_tpu.utils import controller_utils
+        try:
+            vm_svcs = controller_utils.rpc(
+                handle, 'skypilot_tpu.serve.rpc',
+                ['status'] + (['--service-name', service_name]
+                              if service_name else []))
+            head = handle.cluster_info.head_instance
+            ip = head.external_ip or head.internal_ip
+            for svc in vm_svcs:
+                svc['controller'] = 'vm'
+                if svc.get('endpoint'):
+                    port = svc['endpoint'].rsplit(':', 1)[-1]
+                    svc['endpoint'] = f'{ip}:{port}'
+                out.append(svc)
+        except exceptions.SkyTpuError as e:
+            logger.warning(f'serve controller cluster unreachable: {e}')
+    return out
+
+
+def vm_down(service_name: str) -> None:
+    from skypilot_tpu.utils import controller_utils
+    handle = _vm_handle()
+    if handle is None:
+        raise exceptions.SkyTpuError('No serve controller cluster is up.')
+    controller_utils.rpc(handle, 'skypilot_tpu.serve.rpc',
+                         ['down', '--service-name', service_name],
+                         timeout=180)
+
+
+def vm_update(service_name: str, task: task_lib.Task) -> int:
+    import tempfile
+    from skypilot_tpu.utils import controller_utils
+    if task.service is None:
+        raise exceptions.InvalidTaskError(
+            'Task YAML needs a `service:` section for serve update.')
+    handle = _vm_handle()
+    if handle is None:
+        raise exceptions.SkyTpuError('No serve controller cluster is up.')
+    bucket = controller_utils.unique_name(f'skyt-serve-{service_name}')
+    controller_utils.translate_local_mounts_to_storage(
+        task, bucket, task.resources.cloud)
+    with tempfile.TemporaryDirectory() as td:
+        local_yaml = os.path.join(td, 'task.yaml')
+        task.to_yaml(local_yaml)
+        remote_yaml = controller_utils.sync_up_for_rpc(
+            handle, local_yaml, f'~/.skyt_serve/{service_name}',
+            'task.update.yaml')
+    result = controller_utils.rpc(
+        handle, 'skypilot_tpu.serve.rpc',
+        ['update', '--service-name', service_name,
+         '--task-yaml', remote_yaml])
+    return result['version']
+
+
 def down(service_name: str, timeout: float = 120) -> None:
     svc = state.get_service(service_name)
     if svc is None:
@@ -117,3 +241,12 @@ def down(service_name: str, timeout: float = 120) -> None:
             except exceptions.SkyTpuError:
                 pass
     state.remove_service(service_name)
+    # Drop the mount-translation bucket (controller-VM mode; no-op when
+    # the task carries no marker env).
+    if svc.get('task_yaml') and os.path.exists(svc['task_yaml']):
+        from skypilot_tpu.utils import controller_utils
+        try:
+            controller_utils.cleanup_translation_bucket(
+                task_lib.Task.from_yaml(svc['task_yaml']))
+        except exceptions.SkyTpuError:
+            pass

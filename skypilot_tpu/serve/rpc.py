@@ -1,0 +1,60 @@
+"""VM-side serve RPC: runs ON the serve controller cluster, invoked by
+the client over the cluster's CommandRunner (reference analog: the
+ServeCodeGen strings sky serve runs over SSH on its controller VM,
+sky/serve/serve_utils.py). One `SKYT_JSON:` line per call.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _print_json(payload) -> None:
+    print('SKYT_JSON: ' + json.dumps(payload), flush=True)
+
+
+def main() -> int:
+    # VM-local state universe (see jobs/rpc.py).
+    os.environ['SKYT_HOME'] = os.path.expanduser('~/.skyt')
+
+    parser = argparse.ArgumentParser(prog='skypilot_tpu.serve.rpc')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    p_up = sub.add_parser('up')
+    p_up.add_argument('--service-name', required=True)
+    p_up.add_argument('--task-yaml', required=True)
+    p_status = sub.add_parser('status')
+    p_status.add_argument('--service-name', default=None)
+    p_down = sub.add_parser('down')
+    p_down.add_argument('--service-name', required=True)
+    p_update = sub.add_parser('update')
+    p_update.add_argument('--service-name', required=True)
+    p_update.add_argument('--task-yaml', required=True)
+    args = parser.parse_args()
+
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import core as serve_core
+
+    if args.cmd == 'up':
+        pid = serve_core.start_controller(
+            args.service_name, os.path.expanduser(args.task_yaml))
+        _print_json({'pid': pid})
+        return 0
+    if args.cmd == 'status':
+        _print_json(serve_core.status(args.service_name))
+        return 0
+    if args.cmd == 'down':
+        serve_core.down(args.service_name)
+        _print_json({'down': args.service_name})
+        return 0
+    if args.cmd == 'update':
+        task = task_lib.Task.from_yaml(os.path.expanduser(args.task_yaml))
+        version = serve_core.update(args.service_name, task)
+        _print_json({'version': version})
+        return 0
+    return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,223 @@
+"""Controller-VM recursion helpers (reference: sky/utils/controller_utils.py,
+notably controller selection :438 and the local->bucket file-mount
+translation :664).
+
+The reference's signature architecture: the managed-jobs and serve
+controllers are *tasks launched through the framework itself* onto a
+framework-provisioned controller cluster. This module holds the shared
+plumbing for that recursion:
+
+  * controller cluster names + sizing (cheap CPU shape, not TPU),
+  * local->bucket translation: the controller VM cannot see the client's
+    disk, so workdir and local file_mounts are uploaded once into an
+    intermediate bucket and rewritten as cloud URIs the VM-side launch
+    resolves,
+  * the RPC transport: small `python -m skypilot_tpu.<sub>.rpc` commands
+    run on the controller VM over its CommandRunner, returning one
+    `SKYT_JSON:` line (same wire format as the cluster agent CLI).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_user_state
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.agent import constants as agent_constants
+
+logger = sky_logging.init_logger(__name__)
+
+JOBS_CONTROLLER_CLUSTER = 'skyt-jobs-controller'
+SERVE_CONTROLLER_CLUSTER = 'skyt-serve-controller'
+
+# Records which intermediate bucket a task's local mounts were translated
+# into ('<STORE_TYPE>:<bucket>'), so the VM-side controller can delete it
+# when the job/service is done (reference cleans its filemounts bucket the
+# same way).
+TRANSLATION_BUCKET_ENV = 'SKYT_TRANSLATION_BUCKET'
+
+# Client env vars forwarded to controller-VM RPCs so nested launches
+# behave like the client's (fake-cloud gating, scheduler/poll tuning).
+_PASSTHROUGH_ENV_VARS = (
+    'SKYT_ENABLE_FAKE_CLOUD',
+    'SKYT_JOBS_POLL_SECONDS',
+    'SKYT_JOBS_RETRY_GAP_SECONDS',
+    'SKYT_JOBS_MAX_RESTARTS_ON_ERRORS',
+    'SKYT_SERVE_TICK_SECONDS',
+)
+
+
+def passthrough_envs() -> Dict[str, str]:
+    return {k: os.environ[k] for k in _PASSTHROUGH_ENV_VARS
+            if k in os.environ}
+
+
+def controller_resources(user_cloud: Optional[str]) -> Any:
+    """Cheap CPU shape for the controller VM (reference sizes 4 vCPU /
+    8 GB via controller_utils.py:438 + catalog lookup). The fake cloud
+    provisions the same GCE shapes as localhost directory-hosts."""
+    from skypilot_tpu import catalog
+    cloud = user_cloud or 'gcp'
+    itype = catalog.cheapest_instance_by_shape(min_vcpus=4,
+                                               min_memory_gb=8)
+    if itype is None:
+        raise exceptions.ResourcesUnavailableError(
+            'No instance type in the catalog fits the controller shape '
+            '(4 vCPU / 8 GB).')
+    return resources_lib.Resources.new(cloud=cloud, instance_type=itype)
+
+
+def translate_local_mounts_to_storage(task: task_lib.Task,
+                                      bucket_name: str,
+                                      cloud: Optional[str]) -> None:
+    """Upload workdir + local file_mounts into an intermediate bucket and
+    rewrite them as cloud URIs (reference: controller_utils.py:664
+    maybe_translate_local_file_mounts_and_sync_up). Mutates `task`.
+
+    Cloud-URI file_mounts and storage_mounts pass through untouched (the
+    VM-side launch resolves them itself)."""
+    from skypilot_tpu.data import storage as storage_lib
+    store_cls = (storage_lib.LocalStore if cloud == 'fake'
+                 else storage_lib.GcsStore)
+    store = store_cls(bucket_name)
+
+    def _uri(subpath: str) -> str:
+        if isinstance(store, storage_lib.LocalStore):
+            return f'file://{store._dir()}/{subpath}'
+        return f'gs://{bucket_name}/{subpath}'
+
+    uploads: List[tuple] = []   # (local path, subpath)
+    new_mounts: Dict[str, str] = {}
+    if task.workdir:
+        uploads.append((task.workdir, 'workdir'))
+        new_mounts[agent_constants.WORKDIR] = _uri('workdir')
+        task.workdir = None
+    from skypilot_tpu import cloud_stores
+    for i, (dst, src) in enumerate(task.file_mounts.items()):
+        if cloud_stores.is_cloud_store_url(src):
+            new_mounts[dst] = src
+            continue
+        src_path = os.path.expanduser(src)
+        if not os.path.exists(src_path):
+            raise exceptions.InvalidTaskError(
+                f'file_mounts source not found: {src}')
+        if os.path.isfile(src_path):
+            sub = f'mount-{i}/{os.path.basename(src_path)}'
+        else:
+            sub = f'mount-{i}'
+        uploads.append((src_path, sub))
+        new_mounts[dst] = _uri(sub)
+    if uploads:
+        store.create()
+        for src_path, sub in uploads:
+            store.upload_to(src_path, sub)
+        logger.info(f'Translated {len(uploads)} local mount(s) into '
+                    f'{store.uri} for the controller VM.')
+        if isinstance(store, storage_lib.LocalStore):
+            # Path-addressed (the VM deletes it by path — its own
+            # SKYT_HOME differs from the client's where the dir lives).
+            tag = f'LOCAL:{store._dir()}'
+        else:
+            tag = f'GCS:{bucket_name}'
+        task.envs[TRANSLATION_BUCKET_ENV] = tag
+    task.file_mounts = new_mounts
+
+
+def cleanup_translation_bucket(task: task_lib.Task) -> None:
+    """Best-effort delete of the intermediate mount-translation bucket a
+    task carries (set by translate_local_mounts_to_storage). Called by
+    the VM-side controller when the job/service is done — each
+    launch/update gets a uniquely-named bucket, so deletion is safe."""
+    import shutil
+    from skypilot_tpu.data import storage as storage_lib
+    tag = task.envs.get(TRANSLATION_BUCKET_ENV)
+    if not tag or ':' not in tag:
+        return
+    store_type, bucket = tag.split(':', 1)
+    try:
+        if store_type == 'LOCAL':
+            shutil.rmtree(bucket, ignore_errors=True)
+        else:
+            storage_lib.GcsStore(bucket).delete()
+        logger.info(f'Deleted translation bucket {bucket!r}.')
+    except Exception as e:  # noqa: BLE001 — cleanup must not fail the job
+        logger.warning(f'Could not delete translation bucket '
+                       f'{bucket!r}: {e}')
+
+
+def ensure_controller_cluster(cluster_name: str,
+                              user_cloud: Optional[str]) -> Any:
+    """Provision (or reuse) the controller cluster and return its handle.
+    The provision path rsyncs the framework runtime onto the VM
+    (provisioner.setup_runtime_on_cluster), which is all a controller
+    needs — there is no long-lived entry process; controllers are
+    spawned per-job/per-service via RPC."""
+    from skypilot_tpu import execution
+    record = global_user_state.get_cluster(cluster_name)
+    if (record is not None and record['handle'] is not None
+            and record['status'] == global_user_state.ClusterStatus.UP):
+        return record['handle']
+    boot_task = task_lib.Task(name=cluster_name)
+    boot_task.set_resources(controller_resources(user_cloud))
+    logger.info(f'Launching controller cluster {cluster_name!r}...')
+    _, handle = execution.launch(boot_task, cluster_name=cluster_name,
+                                 detach_run=True, quiet_optimizer=True)
+    return handle
+
+
+def controller_handle(cluster_name: str) -> Optional[Any]:
+    """Handle of an existing controller cluster, or None."""
+    record = global_user_state.get_cluster(cluster_name)
+    if record is None or record['handle'] is None:
+        return None
+    return record['handle']
+
+
+def rpc(handle: Any, module: str, args: List[str],
+        stream: bool = False, timeout: Optional[float] = None) -> Any:
+    """Run `python -m <module> <args>` on the controller VM. With
+    stream=False, parses and returns the SKYT_JSON payload; with
+    stream=True, streams output to the client tty and returns the exit
+    code (log tailing)."""
+    import shlex
+    runner = handle.head_runner()
+    cmd = (f'PYTHONPATH={agent_constants.RUNTIME_DIR} '
+           f'python3 -m {module} '
+           + ' '.join(shlex.quote(a) for a in args))
+    env = passthrough_envs() or None
+    if stream:
+        return runner.run(cmd, env=env, stream_logs=True, timeout=timeout)
+    rc, out, err = runner.run(cmd, env=env, require_outputs=True,
+                              timeout=timeout)
+    if rc != 0:
+        raise exceptions.CommandError(rc, f'controller rpc {module}',
+                                      err or out)
+    for line in out.splitlines():
+        if line.startswith('SKYT_JSON: '):
+            return json.loads(line[len('SKYT_JSON: '):])
+    raise exceptions.CommandError(1, f'controller rpc {module}',
+                                  f'No SKYT_JSON in: {out[:500]}')
+
+
+def sync_up_for_rpc(handle: Any, local_path: str, remote_dir: str,
+                    remote_name: str) -> str:
+    """Ship one client file to the controller VM; returns the VM path."""
+    from skypilot_tpu.cloud_stores import _quote_dest
+    runner = handle.head_runner()
+    runner.run(f'mkdir -p {_quote_dest(remote_dir)}', check=True)
+    remote = f'{remote_dir}/{remote_name}'
+    runner.rsync(local_path, remote, up=True)
+    return remote
+
+
+def unique_name(prefix: str) -> str:
+    """Unique, bucket-name-safe identifier: GCS bucket names (and remote
+    shell paths) allow only lowercase letters, digits, and dashes."""
+    safe = re.sub(r'-+', '-', re.sub(r'[^a-z0-9-]', '-', prefix.lower()))
+    return f'{safe.strip("-")}-{int(time.time() * 1000) % 10**10}'
