@@ -10,6 +10,12 @@ Endpoints:
     GET  /health              -> 200 once the engine compiled a step
     POST /generate            -> {"prompt": [ids] | "text", "max_new_tokens": N}
                                  returns {"tokens": [...], "text": "..."}
+                                 With "stream": true -> Server-Sent Events:
+                                 one `data: {"token": t, "text": ...}` per
+                                 generated token as the engine emits it
+                                 (JetStream-style token streaming,
+                                 reference examples/tpu/v6e/README.md:104),
+                                 ending with `data: [DONE]`.
 
 Tokenization is byte-level (UTF-8 byte + 3 reserved ids) so demos work
 without shipping a tokenizer asset; real deployments pass token ids.
@@ -87,6 +93,9 @@ class ModelServer:
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # HTTP/1.1 + explicit framing on every response (length or
+            # chunked) so streams pass through proxies correctly.
+            protocol_version = 'HTTP/1.1'
 
             def log_message(self, *args):
                 pass
@@ -123,11 +132,15 @@ class ModelServer:
                     else:
                         raise ValueError('prompt must be str or [int]')
                     max_new = int(req.get('max_new_tokens', 64))
+                    stream = bool(req.get('stream', False))
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {'error': str(e)})
                     return
                 out_q: queue.Queue = queue.Queue()
                 server.request_queue.put((tokens, max_new, out_q))
+                if stream:
+                    self._stream_sse(out_q)
+                    return
                 toks: List[int] = []
                 error = None
                 while True:
@@ -143,6 +156,37 @@ class ModelServer:
                     return
                 self._json(200, {'tokens': toks,
                                  'text': decode_tokens(toks)})
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f'{len(data):x}\r\n'.encode() + data
+                                 + b'\r\n')
+                self.wfile.flush()
+
+            def _stream_sse(self, out_q: 'queue.Queue') -> None:
+                """Emit each token the moment the engine's decode loop
+                produces it — the engine's queue API was built for this;
+                round 1 only ever drained it at the end."""
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/event-stream')
+                self.send_header('Cache-Control', 'no-cache')
+                self.send_header('Transfer-Encoding', 'chunked')
+                self.end_headers()
+                try:
+                    while True:
+                        item = out_q.get()
+                        if item is None:
+                            break
+                        if isinstance(item, Exception):
+                            payload = {'error': str(item)}
+                        else:
+                            payload = {'token': item,
+                                       'text': decode_tokens([item])}
+                        self._chunk(b'data: ' + json.dumps(payload).encode()
+                                    + b'\n\n')
+                    self._chunk(b'data: [DONE]\n\n')
+                    self._chunk(b'')  # terminating 0-length chunk
+                except BrokenPipeError:
+                    pass  # client went away mid-stream; engine finishes
 
         class ThreadingServer(http.server.ThreadingHTTPServer):
             daemon_threads = True

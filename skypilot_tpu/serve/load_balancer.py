@@ -71,53 +71,99 @@ class LoadBalancer:
                     self.request_timestamps[0] < cutoff:
                 self.request_timestamps.pop(0)
 
+    _CHUNK = 64 * 1024
+
     def _proxy(self, handler: http.server.BaseHTTPRequestHandler) -> None:
+        """Streaming reverse proxy: chunks are forwarded to the client AS
+        the replica produces them (reference streams the same way,
+        load_balancer.py:174 aiohttp proxy) — token streams arrive
+        incrementally and large responses never buffer whole in LB
+        memory. Retries only until the upstream response STARTS; after
+        the first byte is committed a failure aborts the connection."""
         self.record_request()
         body = None
         length = handler.headers.get('Content-Length')
         if length:
             body = handler.rfile.read(int(length))
         last_error = 'no ready replicas'
+        conn = resp = replica = None
         for _ in range(self.max_retries):
             replicas = self.get_ready_replicas()
             if not replicas:
                 break
-            replica = self.policy.select(replicas)
-            replica.active_requests += 1
+            candidate = self.policy.select(replicas)
+            candidate.active_requests += 1
             try:
-                host, port = replica.endpoint.split(':')
-                conn = http.client.HTTPConnection(host, int(port),
-                                                  timeout=60)
+                host, port = candidate.endpoint.split(':')
+                c = http.client.HTTPConnection(host, int(port),
+                                               timeout=60)
                 headers = {k: v for k, v in handler.headers.items()
                            if k.lower() not in _HOP_HEADERS}
-                conn.request(handler.command, handler.path, body=body,
-                             headers=headers)
-                resp = conn.getresponse()
-                payload = resp.read()
-                handler.send_response(resp.status)
-                for k, v in resp.getheaders():
-                    if k.lower() not in _HOP_HEADERS and \
-                            k.lower() != 'content-length':
-                        handler.send_header(k, v)
-                handler.send_header('Content-Length', str(len(payload)))
-                handler.end_headers()
-                handler.wfile.write(payload)
-                conn.close()
-                return
+                c.request(handler.command, handler.path, body=body,
+                          headers=headers)
+                resp = c.getresponse()
+                conn, replica = c, candidate
+                break
             except Exception as e:  # noqa: BLE001 — retry next replica
                 last_error = str(e)
-            finally:
-                replica.active_requests -= 1
-        handler.send_response(503)
-        msg = f'No ready replicas ({last_error})'.encode()
-        handler.send_header('Content-Length', str(len(msg)))
-        handler.end_headers()
-        handler.wfile.write(msg)
+                candidate.active_requests -= 1
+        if resp is None:
+            handler.send_response(503)
+            msg = f'No ready replicas ({last_error})'.encode()
+            handler.send_header('Content-Length', str(len(msg)))
+            handler.end_headers()
+            handler.wfile.write(msg)
+            return
+        try:
+            handler.send_response(resp.status)
+            upstream_len = resp.getheader('Content-Length')
+            for k, v in resp.getheaders():
+                if k.lower() not in _HOP_HEADERS and \
+                        k.lower() != 'content-length':
+                    handler.send_header(k, v)
+            chunked = upstream_len is None
+            if chunked:
+                # Close-delimited or chunked upstream -> chunked to the
+                # client (the handler speaks HTTP/1.1).
+                handler.send_header('Transfer-Encoding', 'chunked')
+            else:
+                handler.send_header('Content-Length', upstream_len)
+            handler.end_headers()
+            while True:
+                # read1 returns as soon as SOME data is available —
+                # first-token latency, not full-response latency.
+                chunk = (resp.read1(self._CHUNK)
+                         if hasattr(resp, 'read1')
+                         else resp.read(self._CHUNK))
+                if not chunk:
+                    break
+                if chunked:
+                    handler.wfile.write(
+                        f'{len(chunk):x}\r\n'.encode() + chunk + b'\r\n')
+                else:
+                    handler.wfile.write(chunk)
+                handler.wfile.flush()
+            if chunked:
+                handler.wfile.write(b'0\r\n\r\n')
+                handler.wfile.flush()
+        except Exception as e:  # noqa: BLE001 — mid-stream failure
+            logger.warning(f'proxy stream aborted: {e}')
+            try:
+                handler.wfile.close()
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            replica.active_requests -= 1
+            conn.close()
 
     def serve_forever_in_thread(self) -> threading.Thread:
         lb = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
+            # HTTP/1.1 so chunked transfer-encoding (token streaming) is
+            # legal on responses without a Content-Length.
+            protocol_version = 'HTTP/1.1'
+
             def log_message(self, *args):
                 pass
 
