@@ -75,7 +75,8 @@ def _write_dag_yaml(dag) -> str:
     return dag_yaml
 
 
-def _launch_on_controller_vm(dag, job_name: str) -> int:
+def _launch_on_controller_vm(dag, job_name: str,
+                             detach: bool = True) -> int:
     """Controller-VM recursion: provision/reuse the jobs controller
     cluster, translate local mounts to a bucket, ship the dag YAML, and
     submit over RPC. Returns the VM-side managed job id."""
@@ -103,6 +104,42 @@ def _launch_on_controller_vm(dag, job_name: str) -> int:
     logger.info(f'Managed job {job_id} ({job_name!r}) submitted to '
                 f'controller cluster '
                 f'{controller_utils.JOBS_CONTROLLER_CLUSTER!r}.')
+    if not detach:
+        # Block until the VM-side job reaches a terminal status —
+        # detach=False promises blocking semantics in both modes.
+        # Transient RPC failures (controller VM briefly unreachable)
+        # must not surface as a failed launch: the job IS submitted and
+        # keeps running regardless of this client-side poll.
+        terminal = {s.value for s in state.ManagedJobStatus
+                    if s.is_terminal()}
+        consecutive_errors = 0
+        while True:
+            try:
+                vm_jobs = controller_utils.rpc(
+                    handle, 'skypilot_tpu.jobs.rpc', ['queue'])
+                rec = next((j for j in vm_jobs
+                            if j['job_id'] == job_id), None)
+            except exceptions.SkyTpuError as e:
+                rec = None
+                consecutive_errors += 1
+                logger.warning(f'poll of VM-side job {job_id} failed '
+                               f'({consecutive_errors}): {e}')
+            else:
+                if rec is None:
+                    # VM queue no longer lists the job (DB reset or
+                    # reaped); detach rather than spin forever.
+                    consecutive_errors += 1
+                else:
+                    consecutive_errors = 0
+                    if rec['status'] in terminal:
+                        break
+            if consecutive_errors >= 15:
+                logger.warning(
+                    f'Managed job {job_id} unpollable for '
+                    f'{consecutive_errors} rounds; detaching (check '
+                    '`skyt jobs queue` for its state).')
+                break
+            time.sleep(2)
     return job_id
 
 
@@ -117,7 +154,7 @@ def launch(task_or_dag, name: Optional[str] = None,
         raise exceptions.NotSupportedError(
             f"controller must be 'local' or 'vm', got {controller!r}")
     if controller == 'vm':
-        return _launch_on_controller_vm(dag, job_name)
+        return _launch_on_controller_vm(dag, job_name, detach)
 
     from skypilot_tpu.jobs import scheduler
     dag_yaml = _write_dag_yaml(dag)

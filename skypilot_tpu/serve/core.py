@@ -83,9 +83,13 @@ def _up_on_controller_vm(task: task_lib.Task, name: str) -> str:
     from skypilot_tpu.utils import controller_utils
     handle = controller_utils.ensure_controller_cluster(
         controller_utils.SERVE_CONTROLLER_CLUSTER, task.resources.cloud)
-    bucket = controller_utils.unique_name(f'skyt-serve-{name}')
+    # One stable bucket per service: updates re-upload into the same
+    # bucket (each version under its own subdir), so `down` — which
+    # reads only the latest task_yaml — cleans every version's mounts.
+    bucket = controller_utils.stable_bucket_name(f'skyt-serve-{name}')
     controller_utils.translate_local_mounts_to_storage(
-        task, bucket, task.resources.cloud)
+        task, bucket, task.resources.cloud,
+        subdir=controller_utils.unique_name('v'), always_tag=True)
     with tempfile.TemporaryDirectory() as td:
         local_yaml = os.path.join(td, 'task.yaml')
         task.to_yaml(local_yaml)
@@ -194,9 +198,11 @@ def vm_update(service_name: str, task: task_lib.Task) -> int:
     handle = _vm_handle()
     if handle is None:
         raise exceptions.SkyTpuError('No serve controller cluster is up.')
-    bucket = controller_utils.unique_name(f'skyt-serve-{service_name}')
+    bucket = controller_utils.stable_bucket_name(
+        f'skyt-serve-{service_name}')
     controller_utils.translate_local_mounts_to_storage(
-        task, bucket, task.resources.cloud)
+        task, bucket, task.resources.cloud,
+        subdir=controller_utils.unique_name('v'), always_tag=True)
     with tempfile.TemporaryDirectory() as td:
         local_yaml = os.path.join(td, 'task.yaml')
         task.to_yaml(local_yaml)

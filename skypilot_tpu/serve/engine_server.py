@@ -185,8 +185,12 @@ class ModelServer:
                                     + b'\n\n')
                     self._chunk(b'data: [DONE]\n\n')
                     self._chunk(b'')  # terminating 0-length chunk
-                except BrokenPipeError:
-                    pass  # client went away mid-stream; engine finishes
+                except OSError:
+                    # Client went away mid-stream (BrokenPipe /
+                    # ConnectionReset / other socket errors are all
+                    # OSError); the engine finishes into the orphaned
+                    # queue harmlessly.
+                    pass
 
         class ThreadingServer(http.server.ThreadingHTTPServer):
             daemon_threads = True

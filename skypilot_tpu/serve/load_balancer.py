@@ -73,6 +73,39 @@ class LoadBalancer:
 
     _CHUNK = 64 * 1024
 
+    @staticmethod
+    def _read_chunked(rfile) -> bytes:
+        """Drain a chunked-encoded request body from the client socket.
+        Consumes any trailer section so a keep-alive connection's next
+        request parses cleanly. Raises ValueError on malformed framing
+        (surfaced to the client as a 400 by _proxy)."""
+        parts = []
+        while True:
+            raw = rfile.readline(65536)
+            if raw == b'':
+                # EOF mid-body: a truncated upload must NOT be forwarded
+                # as a complete request.
+                raise ValueError('truncated chunked body (EOF)')
+            size_line = raw.strip()
+            try:
+                size = int(size_line.split(b';')[0] or b'0', 16)
+            except ValueError:
+                raise ValueError(
+                    f'malformed chunk size line: {size_line[:64]!r}')
+            if size == 0:
+                # Trailer headers (if any) end with a blank line.
+                while True:
+                    line = rfile.readline(65536)
+                    if line in (b'\r\n', b'\n', b''):
+                        break
+                break
+            chunk = rfile.read(size)
+            if len(chunk) < size:
+                raise ValueError('truncated chunk data (EOF)')
+            parts.append(chunk)
+            rfile.read(2)  # CRLF after each chunk
+        return b''.join(parts)
+
     def _proxy(self, handler: http.server.BaseHTTPRequestHandler) -> None:
         """Streaming reverse proxy: chunks are forwarded to the client AS
         the replica produces them (reference streams the same way,
@@ -83,7 +116,28 @@ class LoadBalancer:
         self.record_request()
         body = None
         length = handler.headers.get('Content-Length')
-        if length:
+        # RFC 7230: when both Content-Length and Transfer-Encoding are
+        # present, Transfer-Encoding wins — parsing by Content-Length
+        # here would desync the keep-alive connection (smuggling
+        # pattern), so the chunked branch is checked FIRST.
+        if 'chunked' in handler.headers.get('Transfer-Encoding',
+                                            '').lower():
+            # De-chunk the request body and forward it length-delimited
+            # (http.client re-frames; upstreams need not speak chunked
+            # requests).
+            try:
+                body = self._read_chunked(handler.rfile)
+            except ValueError as e:
+                msg = str(e).encode()
+                handler.send_response(400)
+                handler.send_header('Content-Length', str(len(msg)))
+                # Framing is corrupt; the connection can't be reused.
+                handler.send_header('Connection', 'close')
+                handler.end_headers()
+                handler.wfile.write(msg)
+                handler.close_connection = True
+                return
+        elif length:
             body = handler.rfile.read(int(length))
         last_error = 'no ready replicas'
         conn = resp = replica = None
@@ -93,12 +147,16 @@ class LoadBalancer:
                 break
             candidate = self.policy.select(replicas)
             candidate.active_requests += 1
+            c = None
             try:
                 host, port = candidate.endpoint.split(':')
                 c = http.client.HTTPConnection(host, int(port),
                                                timeout=60)
                 headers = {k: v for k, v in handler.headers.items()
-                           if k.lower() not in _HOP_HEADERS}
+                           if k.lower() not in _HOP_HEADERS
+                           and k.lower() != 'content-length'}
+                if body is not None:
+                    headers['Content-Length'] = str(len(body))
                 c.request(handler.command, handler.path, body=body,
                           headers=headers)
                 resp = c.getresponse()
@@ -107,6 +165,11 @@ class LoadBalancer:
             except Exception as e:  # noqa: BLE001 — retry next replica
                 last_error = str(e)
                 candidate.active_requests -= 1
+                if c is not None:
+                    try:
+                        c.close()
+                    except Exception:  # noqa: BLE001
+                        pass
         if resp is None:
             handler.send_response(503)
             msg = f'No ready replicas ({last_error})'.encode()
@@ -115,11 +178,14 @@ class LoadBalancer:
             handler.wfile.write(msg)
             return
         try:
+            # send_response emits its own Server/Date; drop the
+            # upstream's copies or the client sees duplicates.
             handler.send_response(resp.status)
             upstream_len = resp.getheader('Content-Length')
             for k, v in resp.getheaders():
                 if k.lower() not in _HOP_HEADERS and \
-                        k.lower() != 'content-length':
+                        k.lower() not in ('content-length', 'date',
+                                          'server'):
                     handler.send_header(k, v)
             chunked = upstream_len is None
             if chunked:

@@ -75,10 +75,21 @@ def controller_resources(user_cloud: Optional[str]) -> Any:
 
 def translate_local_mounts_to_storage(task: task_lib.Task,
                                       bucket_name: str,
-                                      cloud: Optional[str]) -> None:
+                                      cloud: Optional[str],
+                                      subdir: str = '',
+                                      always_tag: bool = False) -> None:
     """Upload workdir + local file_mounts into an intermediate bucket and
     rewrite them as cloud URIs (reference: controller_utils.py:664
     maybe_translate_local_file_mounts_and_sync_up). Mutates `task`.
+
+    `subdir` namespaces the uploads inside the bucket — callers that
+    REUSE a bucket across versions (serve updates) pass a fresh subdir
+    per version so old and new mounts never merge, while `down` still
+    cleans all versions by deleting the one bucket. Those callers also
+    pass `always_tag=True`: the cleanup marker must survive an update
+    that itself uploads nothing, or `down` (which reads only the LATEST
+    task_yaml) would orphan the bucket holding earlier versions'
+    mounts.
 
     Cloud-URI file_mounts and storage_mounts pass through untouched (the
     VM-side launch resolves them itself)."""
@@ -86,11 +97,12 @@ def translate_local_mounts_to_storage(task: task_lib.Task,
     store_cls = (storage_lib.LocalStore if cloud == 'fake'
                  else storage_lib.GcsStore)
     store = store_cls(bucket_name)
+    pre = f'{subdir}/' if subdir else ''
 
     def _uri(subpath: str) -> str:
         if isinstance(store, storage_lib.LocalStore):
-            return f'file://{store._dir()}/{subpath}'
-        return f'gs://{bucket_name}/{subpath}'
+            return f'file://{store._dir()}/{pre}{subpath}'
+        return f'gs://{bucket_name}/{pre}{subpath}'
 
     uploads: List[tuple] = []   # (local path, subpath)
     new_mounts: Dict[str, str] = {}
@@ -116,9 +128,10 @@ def translate_local_mounts_to_storage(task: task_lib.Task,
     if uploads:
         store.create()
         for src_path, sub in uploads:
-            store.upload_to(src_path, sub)
+            store.upload_to(src_path, f'{pre}{sub}')
         logger.info(f'Translated {len(uploads)} local mount(s) into '
                     f'{store.uri} for the controller VM.')
+    if uploads or always_tag:
         if isinstance(store, storage_lib.LocalStore):
             # Path-addressed (the VM deletes it by path — its own
             # SKYT_HOME differs from the client's where the dir lives).
@@ -216,8 +229,34 @@ def sync_up_for_rpc(handle: Any, local_path: str, remote_dir: str,
     return remote
 
 
-def unique_name(prefix: str) -> str:
-    """Unique, bucket-name-safe identifier: GCS bucket names (and remote
-    shell paths) allow only lowercase letters, digits, and dashes."""
+def _sanitize_bucket_prefix(prefix: str) -> str:
+    """Bucket-name-safe prefix: GCS bucket names allow only lowercase
+    letters, digits, and dashes, and cap at 63 chars total — truncate
+    the prefix so appending a suffix stays within the limit."""
     safe = re.sub(r'-+', '-', re.sub(r'[^a-z0-9-]', '-', prefix.lower()))
-    return f'{safe.strip("-")}-{int(time.time() * 1000) % 10**10}'
+    return safe.strip('-')[:50].rstrip('-')
+
+
+def unique_name(prefix: str) -> str:
+    """Unique, bucket-name-safe identifier (<= 61 chars)."""
+    return (f'{_sanitize_bucket_prefix(prefix)}'
+            f'-{int(time.time() * 1000) % 10**10}')
+
+
+def stable_bucket_name(prefix: str) -> str:
+    """Deterministic, bucket-name-safe identifier, stable across calls
+    for the same (prefix, user, host, SKYT_HOME). Serve up/update reuse
+    ONE translation bucket per service so `down` cleans everything — a
+    fresh timestamped bucket per update would orphan every predecessor
+    (advisor r2 finding, serve/core.py). The RAW prefix is hashed into
+    the suffix so names that sanitize/truncate identically still get
+    distinct buckets; user+host+home disambiguate GCS's global
+    namespace across clients."""
+    import getpass
+    import hashlib
+    import socket
+    from skypilot_tpu import config as config_lib
+    seed = (f'{prefix}:{getpass.getuser()}:{socket.gethostname()}:'
+            f'{config_lib.home_dir()}')
+    suffix = hashlib.sha1(seed.encode()).hexdigest()[:12]
+    return f'{_sanitize_bucket_prefix(prefix)[:46].rstrip("-")}-{suffix}'
