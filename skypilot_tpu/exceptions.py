@@ -53,6 +53,13 @@ class ResourcesMismatchError(SkyTpuError):
     """Task demands don't fit the cluster it was asked to run on."""
 
 
+class InfeasibleResourcesError(InvalidResourcesError):
+    """The requested accelerator cannot physically run the workload
+    (e.g. training footprint exceeds the slice's HBM). Raised at
+    optimize time by feasibility.check_hbm — before anything is
+    provisioned or billed."""
+
+
 class ProvisionError(SkyTpuError):
     """A single provisioning attempt failed.
 

@@ -52,6 +52,12 @@ def optimize_task(task: task_lib.Task,
                   ) -> OptimizedPlan:
     """Fill `task.best_resources`; return the plan with failover ordering."""
     res = task.resources
+    # HBM-feasibility gate: a task that declares its training footprint
+    # gets its accelerator choice checked against per-chip HBM BEFORE
+    # anything is provisioned — the reference lets this OOM at runtime.
+    if task.train_footprint is not None and res.tpu is not None:
+        from skypilot_tpu import feasibility
+        feasibility.check_hbm(task.train_footprint, res.tpu)
     offerings = res.get_offerings()
     if not offerings:
         raise exceptions.ResourcesUnavailableError(

@@ -53,6 +53,9 @@ class Task:
         self.storage_mounts: Dict[str, Any] = dict(storage_mounts or {})
         self.resources: resources_lib.Resources = resources_lib.Resources()
         self.service: Optional[Any] = None   # serve.SkyServiceSpec
+        # Optional feasibility.TrainFootprint: lets the optimizer reject
+        # accelerator choices whose HBM cannot hold the training state.
+        self.train_footprint: Optional[Any] = None
         self.best_resources = None           # filled by the optimizer
         self._validate()
 
@@ -129,6 +132,10 @@ class Task:
         )
         task.resources = resources_lib.Resources.from_yaml_config(
             config.get('resources'))
+        if config.get('train_footprint') is not None:
+            from skypilot_tpu import feasibility
+            task.train_footprint = feasibility.TrainFootprint.from_yaml_config(
+                config['train_footprint'])
         if config.get('service') is not None:
             try:
                 from skypilot_tpu.serve import service_spec
@@ -181,6 +188,8 @@ class Task:
             cfg['run'] = self.run
         if self.envs:
             cfg['envs'] = dict(self.envs)
+        if self.train_footprint is not None:
+            cfg['train_footprint'] = self.train_footprint.to_yaml_config()
         if self.service is not None:
             cfg['service'] = self.service.to_yaml_config()
         return cfg

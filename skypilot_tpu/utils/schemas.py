@@ -22,8 +22,18 @@ TASK_FIELDS: Dict[str, Any] = {
     'file_mounts': dict,
     'resources': dict,
     'service': dict,
+    'train_footprint': dict,   # optimizer HBM-feasibility hint
     'inputs': dict,     # accepted for reference-YAML compat, unused
     'outputs': dict,    # accepted for reference-YAML compat, unused
+}
+
+TRAIN_FOOTPRINT_FIELDS: Dict[str, Any] = {
+    'params': None,            # int or '8b' style string
+    'seq_len': int,
+    'global_batch': int,
+    'n_layers': int,
+    'dim': int,
+    'vocab_size': int,
 }
 
 SERVICE_FIELDS: Dict[str, Any] = {
@@ -89,6 +99,9 @@ def validate_task_config(config: Dict[str, Any]) -> None:
     if 'num_nodes' in config and config['num_nodes'] is not None:
         if config['num_nodes'] < 1:
             raise exceptions.InvalidTaskError('task.num_nodes must be >= 1')
+    if config.get('train_footprint') is not None:
+        check_fields(config['train_footprint'], TRAIN_FOOTPRINT_FIELDS,
+                     'task.train_footprint')
     for dst, src in (config.get('file_mounts') or {}).items():
         if isinstance(src, dict):
             check_fields(src, STORAGE_FIELDS, f'task.file_mounts.{dst}')
