@@ -85,11 +85,6 @@ class ModelServer:
         logger.info('engine warmed up; serving on :%d', self.port)
 
     def serve_forever(self) -> None:
-        self._warmup()
-        loop = threading.Thread(
-            target=self.engine.run_loop,
-            args=(self.request_queue, self.stop), daemon=True)
-        loop.start()
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -195,7 +190,16 @@ class ModelServer:
         class ThreadingServer(http.server.ThreadingHTTPServer):
             daemon_threads = True
 
+        # Bind + listen BEFORE warmup so `ready` (set at the end of
+        # warmup) guarantees connections are accepted — setting it while
+        # the socket was still unbound made an immediate client connect
+        # race warmup and fail with ECONNREFUSED.
         self._httpd = ThreadingServer(('0.0.0.0', self.port), Handler)
+        self._warmup()
+        loop = threading.Thread(
+            target=self.engine.run_loop,
+            args=(self.request_queue, self.stop), daemon=True)
+        loop.start()
         try:
             self._httpd.serve_forever()
         finally:

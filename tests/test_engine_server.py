@@ -37,9 +37,26 @@ def server():
     srv.request_queue = queue.Queue()
     srv.stop = threading.Event()
     srv._httpd = None
-    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    # Surface a crashed server thread instead of letting later tests die
+    # on an opaque connection error (the module fixture used to discard
+    # ready.wait()'s return — a slow/contended compile or a warmup crash
+    # showed up three tests later as URLError).
+    thread_errors = []
+
+    def _run():
+        try:
+            srv.serve_forever()
+        except BaseException as e:  # noqa: BLE001
+            thread_errors.append(e)
+            raise
+
+    t = threading.Thread(target=_run, daemon=True)
     t.start()
-    srv.ready.wait(timeout=120)
+    ready = srv.ready.wait(timeout=300)
+    if not ready or thread_errors:
+        raise RuntimeError(
+            f'model server failed to warm up (ready={ready}); '
+            f'thread errors: {thread_errors}')
     yield srv, cfg
     srv.shutdown()
 

@@ -1,0 +1,287 @@
+"""Streaming path end-to-end: SSE token streams through the load
+balancer, chunked re-framing of close-delimited upstreams, and
+first-token latency (the round-1 #4 done-criterion: first token arrives
+long before the full response; reference behavior:
+sky/serve/load_balancer.py:174 aiohttp streaming proxy).
+
+The latency-sensitive tests use a deterministic fake upstream (SSE
+events separated by real sleeps) so the assertion measures the PROXY's
+buffering behavior, not model speed. Correctness of the real engine's
+SSE framing is covered against the in-framework model server.
+"""
+import http.client
+import http.server
+import json
+import queue
+import socket
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import engine_server
+from skypilot_tpu.serve.load_balancer import LoadBalancer
+from skypilot_tpu.serve.replica_managers import ReplicaInfo
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------------- #
+# Deterministic fake upstreams
+# --------------------------------------------------------------------- #
+
+N_EVENTS = 8
+EVENT_GAP_S = 0.15
+
+
+class _SlowSSEHandler(http.server.BaseHTTPRequestHandler):
+    """Streams N_EVENTS SSE events, one every EVENT_GAP_S, chunked."""
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get('Content-Length', 0))
+        self.rfile.read(length)
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/event-stream')
+        self.send_header('Transfer-Encoding', 'chunked')
+        self.end_headers()
+
+        def chunk(data: bytes):
+            self.wfile.write(f'{len(data):x}\r\n'.encode() + data
+                             + b'\r\n')
+            self.wfile.flush()
+
+        for i in range(N_EVENTS):
+            chunk(f'data: {{"token": {i}}}\n\n'.encode())
+            time.sleep(EVENT_GAP_S)
+        chunk(b'data: [DONE]\n\n')
+        self.wfile.write(b'0\r\n\r\n')
+        self.wfile.flush()
+
+
+class _CloseDelimitedHandler(http.server.BaseHTTPRequestHandler):
+    """HTTP/1.0-style upstream: no Content-Length, no chunking — the body
+    ends when the server closes the connection. The LB must re-frame this
+    as chunked toward its HTTP/1.1 client."""
+    protocol_version = 'HTTP/1.0'
+    BODY = b''.join(b'line %d of a close-delimited body\n' % i
+                    for i in range(200))
+
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        self.send_response(200)
+        self.send_header('Content-Type', 'text/plain')
+        # Deliberately NO Content-Length.
+        self.end_headers()
+        half = len(self.BODY) // 2
+        self.wfile.write(self.BODY[:half])
+        self.wfile.flush()
+        time.sleep(0.05)
+        self.wfile.write(self.BODY[half:])
+        # close_connection is implicit for HTTP/1.0.
+
+
+@pytest.fixture
+def lb_over(request):
+    """Start `handler_cls` upstream + a LoadBalancer routing to it.
+    Yields the LB port."""
+    handler_cls = request.param
+    up_port = _free_port()
+    upstream = http.server.ThreadingHTTPServer(('127.0.0.1', up_port),
+                                               handler_cls)
+    threading.Thread(target=upstream.serve_forever, daemon=True).start()
+
+    replica = ReplicaInfo(1, 'fake-cluster', up_port)
+    replica.endpoint = f'127.0.0.1:{up_port}'
+    lb = LoadBalancer(_free_port(), lambda: [replica])
+    lb.serve_forever_in_thread()
+    yield lb.port
+    lb.shutdown()
+    upstream.shutdown()
+
+
+def _read_stream_with_times(port: int, method: str = 'POST',
+                            path: str = '/', body: bytes = b'{}'):
+    """Issue a request and read the response incrementally; returns
+    (t_first_byte, t_done, chunks, resp) with times relative to send."""
+    conn = http.client.HTTPConnection('127.0.0.1', port, timeout=30)
+    t0 = time.perf_counter()
+    conn.request(method, path, body=body,
+                 headers={'Content-Type': 'application/json'})
+    resp = conn.getresponse()
+    chunks = []
+    t_first = None
+    while True:
+        piece = resp.read1(65536)
+        if not piece:
+            break
+        if t_first is None:
+            t_first = time.perf_counter() - t0
+        chunks.append(piece)
+    t_done = time.perf_counter() - t0
+    conn.close()
+    return t_first, t_done, chunks, resp
+
+
+# --------------------------------------------------------------------- #
+# LB streaming behavior
+# --------------------------------------------------------------------- #
+
+@pytest.mark.parametrize('lb_over', [_SlowSSEHandler], indirect=True)
+def test_lb_sse_first_token_latency(lb_over):
+    """First SSE event must arrive ~immediately, NOT after the full
+    stream (total is ~N_EVENTS * EVENT_GAP_S = 1.2s)."""
+    t_first, t_done, chunks, resp = _read_stream_with_times(lb_over)
+    assert resp.status == 200
+    body = b''.join(chunks)
+    assert body.count(b'data: ') == N_EVENTS + 1
+    assert body.rstrip().endswith(b'data: [DONE]')
+    total_stream_time = N_EVENTS * EVENT_GAP_S
+    # The proxy must not buffer: first event arrives before even half
+    # the events have been produced (in practice ~0.01s vs 1.2s).
+    assert t_first < 0.5 * total_stream_time, (t_first, t_done)
+    assert t_done > 0.9 * total_stream_time, (t_first, t_done)
+    # And events trickled in over multiple reads, not one burst.
+    assert len(chunks) >= 3
+
+
+@pytest.mark.parametrize('lb_over', [_SlowSSEHandler], indirect=True)
+def test_lb_sse_headers(lb_over):
+    """Content-Type survives the proxy; exactly one Date/Server pair
+    (the LB's own — upstream copies dropped); chunked toward client."""
+    conn = http.client.HTTPConnection('127.0.0.1', lb_over, timeout=30)
+    conn.request('POST', '/', body=b'{}')
+    resp = conn.getresponse()
+    headers = resp.getheaders()
+    names = [k.lower() for k, _ in headers]
+    assert names.count('date') == 1
+    assert names.count('server') <= 1
+    assert resp.getheader('Content-Type') == 'text/event-stream'
+    assert resp.getheader('Content-Length') is None
+    resp.read()
+    conn.close()
+
+
+@pytest.mark.parametrize('lb_over', [_CloseDelimitedHandler],
+                         indirect=True)
+def test_lb_rechunks_close_delimited_upstream(lb_over):
+    """An upstream with neither Content-Length nor chunking (body ends at
+    connection close) must be re-framed as chunked, byte-identical."""
+    t_first, t_done, chunks, resp = _read_stream_with_times(
+        lb_over, method='GET', body=None)
+    assert resp.status == 200
+    assert b''.join(chunks) == _CloseDelimitedHandler.BODY
+    # Client-side http.client only de-chunks when framing is valid, so
+    # reaching here with the full body proves correct chunked framing;
+    # double-check the header too.
+    assert resp.getheader('Content-Length') is None
+
+
+# --------------------------------------------------------------------- #
+# Engine server SSE (real model) + LB -> engine integration
+# --------------------------------------------------------------------- #
+
+@pytest.fixture(scope='module')
+def model_server():
+    port = _free_port()
+    srv = engine_server.ModelServer.__new__(engine_server.ModelServer)
+    cfg = llama.LlamaConfig(
+        vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    srv.engine = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(16, 64),
+            eos_id=engine_server.EOS_ID))
+    srv.port = port
+    srv.ready = threading.Event()
+    srv.request_queue = queue.Queue()
+    srv.stop = threading.Event()
+    srv._httpd = None
+    thread_errors = []
+
+    def _run():
+        try:
+            srv.serve_forever()
+        except BaseException as e:  # noqa: BLE001
+            thread_errors.append(e)
+            raise
+
+    threading.Thread(target=_run, daemon=True).start()
+    ready = srv.ready.wait(timeout=300)
+    if not ready or thread_errors:
+        raise RuntimeError(
+            f'model server failed to warm up (ready={ready}); '
+            f'thread errors: {thread_errors}')
+    yield srv
+    srv.shutdown()
+
+
+def _parse_sse(body: bytes):
+    events = [e[len(b'data: '):] for e in body.split(b'\n\n')
+              if e.startswith(b'data: ')]
+    assert events and events[-1] == b'[DONE]', body[-200:]
+    return [json.loads(e) for e in events[:-1]]
+
+
+def test_engine_sse_matches_nonstream(model_server):
+    """Streamed tokens are framed as SSE ending in [DONE] and match the
+    non-streaming result (greedy decode is deterministic)."""
+    srv = model_server
+    payload = {'prompt': [5, 9, 23], 'max_new_tokens': 6}
+
+    conn = http.client.HTTPConnection('127.0.0.1', srv.port, timeout=120)
+    conn.request('POST', '/generate',
+                 body=json.dumps({**payload, 'stream': True}).encode(),
+                 headers={'Content-Type': 'application/json'})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader('Content-Type') == 'text/event-stream'
+    body = resp.read()
+    conn.close()
+    streamed = [e['token'] for e in _parse_sse(body)]
+
+    conn = http.client.HTTPConnection('127.0.0.1', srv.port, timeout=120)
+    conn.request('POST', '/generate', body=json.dumps(payload).encode(),
+                 headers={'Content-Type': 'application/json'})
+    nonstream = json.loads(conn.getresponse().read())
+    conn.close()
+    assert streamed == nonstream['tokens']
+    assert streamed, 'no tokens generated'
+
+
+def test_engine_sse_through_lb_incremental(model_server):
+    """The full serving data path — client -> LB -> replica model server
+    -> SSE back through the LB — delivers tokens incrementally with
+    correct [DONE] framing."""
+    srv = model_server
+    replica = ReplicaInfo(1, 'fake-cluster', srv.port)
+    replica.endpoint = f'127.0.0.1:{srv.port}'
+    lb = LoadBalancer(_free_port(), lambda: [replica])
+    lb.serve_forever_in_thread()
+    try:
+        payload = {'prompt': [5, 9, 23], 'max_new_tokens': 20,
+                   'stream': True}
+        t_first, t_done, chunks, resp = _read_stream_with_times(
+            lb.port, path='/generate', body=json.dumps(payload).encode())
+        assert resp.status == 200
+        tokens = [e['token'] for e in _parse_sse(b''.join(chunks))]
+        assert len(tokens) >= 1
+        # Incremental delivery: the LB forwarded more than one chunk
+        # (tokens emitted as decoded, not one final burst). The tiny
+        # engine decodes fast, so assert structure, not wall-clock.
+        assert len(chunks) >= 2, (len(chunks), t_first, t_done)
+    finally:
+        lb.shutdown()
