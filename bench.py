@@ -103,10 +103,7 @@ def _flagship_projection(device, peak: float):
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
     from skypilot_tpu.models import llama
-    from skypilot_tpu.parallel import mesh as mesh_lib
-    from skypilot_tpu.train import trainer
 
     device = jax.devices()[0]
     on_tpu = device.platform != 'cpu'
@@ -122,7 +119,6 @@ def main() -> None:
         cfg = llama.llama_tiny()
         batch, seq, steps = 4, 128, 3
 
-    del jnp, mesh_lib, trainer  # used via _measure_mfu
     peak = _tpu_chip_flops(device) if on_tpu else 1e12
     mfu_pct, tok_per_s = _measure_mfu(cfg, batch, seq, steps, peak)
 

@@ -25,11 +25,12 @@ import os
 import subprocess
 import sys
 import time
-from typing import Iterator, Optional
+from typing import Iterator
 
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 logger = sky_logging.init_logger(__name__)
 
@@ -55,20 +56,6 @@ def _lock() -> Iterator[None]:
             yield
         finally:
             fcntl.flock(f, fcntl.LOCK_UN)
-
-
-def _pid_alive(pid: Optional[int]) -> bool:
-    if not pid:
-        return False
-    try:
-        os.kill(pid, 0)
-        return True
-    except ProcessLookupError:
-        return False
-    except PermissionError:
-        # EPERM means the process EXISTS (owned by another user) —
-        # reaping it would orphan a live controller.
-        return True
 
 
 def _reclaim_dead_slots() -> None:

@@ -184,15 +184,26 @@ def setup_runtime_on_cluster(info: common.ClusterInfo) -> None:
 
 
 def start_agent_daemon(info: common.ClusterInfo) -> None:
-    """Start the head daemon (autostop etc.; reference: skylet start,
-    instance_setup.py:440). Idempotent via pidfile."""
+    """Start the head daemon (autostop + controller-liveness events;
+    reference: skylet start, instance_setup.py:440). Idempotent via
+    pidfile.
+
+    The client's tuning env rides along (same set the controller RPCs
+    forward): the daemon's scheduler/serve events spawn controller
+    processes that inherit it — on the fake cloud they would otherwise
+    lack SKYT_ENABLE_FAKE_CLOUD and fail their nested launches."""
+    import shlex
+    from skypilot_tpu.utils import controller_utils
     head_runner = command_runner.runner_from_spec(
         info.head_instance.runner_spec)
     pidfile = f'{agent_constants.AGENT_HOME}/daemon.pid'
+    env_prefix = ' '.join(
+        f'{k}={shlex.quote(v)}'
+        for k, v in controller_utils.passthrough_envs().items())
     cmd = (
         f'if [ -f {pidfile} ] && kill -0 $(cat {pidfile}) 2>/dev/null; '
         f'then true; else '
-        f'PYTHONPATH={agent_constants.RUNTIME_DIR} '
+        f'{env_prefix} PYTHONPATH={agent_constants.RUNTIME_DIR} '
         f'nohup python3 -m skypilot_tpu.agent.daemon '
         f'>> {agent_constants.AGENT_HOME}/daemon.log 2>&1 & '
         f'echo $! > {pidfile}; fi')

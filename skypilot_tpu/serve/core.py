@@ -18,6 +18,27 @@ from skypilot_tpu.serve import state
 logger = sky_logging.init_logger(__name__)
 
 
+def spawn_controller_process(name: str, task_yaml: str) -> int:
+    """Spawn the detached per-service controller process and record its
+    pid in the serve DB immediately — the single spawn site shared by
+    `serve up` and the daemon's ServeControllerEvent restart path.
+    Recording the pid here (not from inside the child, which takes
+    seconds to boot) closes the window where a liveness sweep would see
+    pid=None and spawn a duplicate controller."""
+    svc_dir = config_lib.home_dir() / 'serve' / name
+    svc_dir.mkdir(parents=True, exist_ok=True)
+    log_path = str(svc_dir / 'controller.log')
+    with open(log_path, 'ab') as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.service',
+             '--service-name', name, '--task-yaml',
+             os.path.expanduser(task_yaml)],
+            stdout=log_f, stderr=subprocess.STDOUT,
+            stdin=subprocess.DEVNULL, start_new_session=True)
+    state.set_service(name, controller_pid=proc.pid)
+    return proc.pid
+
+
 def start_controller(name: str, task_yaml: str) -> int:
     """Register the service and spawn its detached controller process on
     THIS machine (the client in local mode; the controller VM when
@@ -30,18 +51,9 @@ def start_controller(name: str, task_yaml: str) -> int:
         raise exceptions.SkyTpuError(
             f'Service {name!r} already exists; use a different name or '
             f'`skyt serve down {name}` first.')
-    svc_dir = config_lib.home_dir() / 'serve' / name
-    svc_dir.mkdir(parents=True, exist_ok=True)
-    log_path = str(svc_dir / 'controller.log')
     state.add_service(name, json.dumps(task.service.to_yaml_config()),
                       task_yaml=task_yaml)
-    with open(log_path, 'ab') as log_f:
-        proc = subprocess.Popen(
-            [sys.executable, '-m', 'skypilot_tpu.serve.service',
-             '--service-name', name, '--task-yaml', task_yaml],
-            stdout=log_f, stderr=subprocess.STDOUT,
-            stdin=subprocess.DEVNULL, start_new_session=True)
-    return proc.pid
+    return spawn_controller_process(name, task_yaml)
 
 
 def up(task: task_lib.Task, service_name: Optional[str] = None,

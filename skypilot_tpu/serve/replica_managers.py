@@ -55,6 +55,37 @@ class ReplicaManager:
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
 
+    def adopt_existing_replicas(self) -> int:
+        """Rebuild in-memory replica records from the serve DB after a
+        controller restart (the daemon's ServeControllerEvent respawns a
+        dead controller; without adoption the new process would leak the
+        old replica clusters and launch fresh ones — the reference's
+        replica manager recovers its replica set from serve_state the
+        same way). Returns the number of adopted live replicas."""
+        adopted = 0
+        for row in state.get_replicas(self.service_name):
+            rid = row['replica_id']
+            self._next_id = max(self._next_id, rid + 1)
+            info = ReplicaInfo(rid, row['cluster_name'],
+                               self._replica_port(rid),
+                               is_spot=self.spec.use_spot,
+                               version=self.version)
+            info.endpoint = row['endpoint']
+            with self._lock:
+                self.replicas[rid] = info
+            if row['endpoint'] and row['status'] not in (
+                    state.ReplicaStatus.SHUTTING_DOWN.value,
+                    state.ReplicaStatus.FAILED.value):
+                # Probes re-establish readiness before it serves again.
+                info.status = state.ReplicaStatus.STARTING
+                adopted += 1
+            else:
+                # Launch/teardown was in flight when the old controller
+                # died; its thread is gone. Terminate the remnant (down
+                # is a no-op when the cluster never came up).
+                self.scale_down(rid)
+        return adopted
+
     def begin_update(self, task: task_lib.Task, spec: SkyServiceSpec,
                      version: int) -> None:
         """`skyt serve update`: future launches use the new task/spec;

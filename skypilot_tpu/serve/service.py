@@ -40,7 +40,13 @@ def main() -> None:
     manager = replica_managers.ReplicaManager(name, task, spec)
     autoscaler = autoscalers.make_autoscaler(spec,
                                              tick_seconds=TICK_SECONDS)
-    current_version = 1
+    # A restarted controller resumes at the DB's version (the daemon
+    # respawns it with the LATEST task_yaml): starting at 1 would make
+    # the first tick treat the registered version as a pending update
+    # and needlessly blue-green-replace every adopted replica.
+    svc0 = state.get_service(name)
+    current_version = (svc0['version'] or 1) if svc0 else 1
+    manager.version = current_version
     lb = lb_lib.LoadBalancer(spec.port, manager.ready_replicas,
                              policy=spec.load_balancing_policy)
 
@@ -64,7 +70,12 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
-    for _ in range(spec.min_replicas):
+    # A restarted controller (daemon ServeControllerEvent) adopts the
+    # replicas its predecessor recorded instead of leaking them.
+    adopted = manager.adopt_existing_replicas()
+    if adopted:
+        logger.info(f'adopted {adopted} existing replica(s) for {name!r}')
+    for _ in range(max(0, spec.min_replicas - len(manager.replicas))):
         manager.scale_up()
     lb.serve_forever_in_thread()
 

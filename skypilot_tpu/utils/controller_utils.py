@@ -18,6 +18,8 @@ plumbing for that recursion:
 """
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import json
 import os
 import re
@@ -42,15 +44,23 @@ SERVE_CONTROLLER_CLUSTER = 'skyt-serve-controller'
 # same way).
 TRANSLATION_BUCKET_ENV = 'SKYT_TRANSLATION_BUCKET'
 
-# Client env vars forwarded to controller-VM RPCs so nested launches
-# behave like the client's (fake-cloud gating, scheduler/poll tuning).
+# Client env vars forwarded to controller-VM RPCs and the head daemon so
+# nested launches behave like the client's (fake-cloud gating,
+# scheduler/poll/event-loop tuning).
 _PASSTHROUGH_ENV_VARS = (
     'SKYT_ENABLE_FAKE_CLOUD',
     'SKYT_JOBS_POLL_SECONDS',
     'SKYT_JOBS_RETRY_GAP_SECONDS',
     'SKYT_JOBS_MAX_RESTARTS_ON_ERRORS',
     'SKYT_SERVE_TICK_SECONDS',
+    'SKYT_AGENT_LOOP_SECONDS',
 )
+
+# Reference: CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP = 10
+# (sky/skylet/constants.py:284, applied in sky/jobs/core.py:150 and
+# sky/serve/core.py:249) — controller VMs stop themselves when no
+# managed job / service has needed them for this long.
+CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP = 10
 
 
 def passthrough_envs() -> Dict[str, str]:
@@ -164,24 +174,63 @@ def cleanup_translation_bucket(task: task_lib.Task) -> None:
                        f'{bucket!r}: {e}')
 
 
+def controller_autostop_minutes() -> float:
+    """Config/env-overridable idle-autostop for controller clusters."""
+    from skypilot_tpu import config as config_lib
+    env = os.environ.get('SKYT_CONTROLLER_IDLE_MINUTES')
+    if env is not None:
+        return float(env)
+    return float(config_lib.get_nested(
+        ['controller', 'idle_minutes_to_autostop'],
+        CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP))
+
+
+@contextlib.contextmanager
+def _launch_lock(cluster_name: str):
+    """Serialize concurrent ensure_controller_cluster calls: two racing
+    `--controller vm` submits must not both see no-UP-record and launch
+    the same cluster name twice (reference serializes via per-cluster
+    file locks, sky/backends/backend_utils.py)."""
+    from skypilot_tpu import config as config_lib
+    path = str(config_lib.home_dir() / f'.launch_{cluster_name}.lock')
+    with open(path, 'w') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
 def ensure_controller_cluster(cluster_name: str,
                               user_cloud: Optional[str]) -> Any:
     """Provision (or reuse) the controller cluster and return its handle.
     The provision path rsyncs the framework runtime onto the VM
     (provisioner.setup_runtime_on_cluster), which is all a controller
     needs — there is no long-lived entry process; controllers are
-    spawned per-job/per-service via RPC."""
+    spawned per-job/per-service via RPC. The boot task carries idle
+    autostop so an unused controller VM stops itself (the daemon's
+    AutostopEvent counts live managed jobs/services as activity)."""
+    import dataclasses
     from skypilot_tpu import execution
-    record = global_user_state.get_cluster(cluster_name)
-    if (record is not None and record['handle'] is not None
-            and record['status'] == global_user_state.ClusterStatus.UP):
-        return record['handle']
-    boot_task = task_lib.Task(name=cluster_name)
-    boot_task.set_resources(controller_resources(user_cloud))
-    logger.info(f'Launching controller cluster {cluster_name!r}...')
-    _, handle = execution.launch(boot_task, cluster_name=cluster_name,
-                                 detach_run=True, quiet_optimizer=True)
-    return handle
+    with _launch_lock(cluster_name):
+        record = global_user_state.get_cluster(cluster_name)
+        if (record is not None and record['handle'] is not None
+                and record['status']
+                == global_user_state.ClusterStatus.UP):
+            return record['handle']
+        boot_task = task_lib.Task(name=cluster_name)
+        res = controller_resources(user_cloud)
+        idle = controller_autostop_minutes()
+        if idle >= 0:
+            res = dataclasses.replace(res, autostop_minutes=idle,
+                                      autostop_down=False)
+        boot_task.set_resources(res)
+        logger.info(f'Launching controller cluster {cluster_name!r}...')
+        _, handle = execution.launch(boot_task,
+                                     cluster_name=cluster_name,
+                                     detach_run=True,
+                                     quiet_optimizer=True)
+        return handle
 
 
 def controller_handle(cluster_name: str) -> Optional[Any]:

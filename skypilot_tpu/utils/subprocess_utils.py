@@ -1,11 +1,27 @@
-"""Thread-pool helpers (reference: sky/utils/subprocess_utils.py)."""
+"""Thread-pool + process helpers (reference: sky/utils/subprocess_utils.py)."""
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Callable, Iterable, List, TypeVar
+import os
+from typing import Callable, Iterable, List, Optional, TypeVar
 
 T = TypeVar('T')
 R = TypeVar('R')
+
+
+def pid_alive(pid: Optional[int]) -> bool:
+    """True if `pid` names a live process (signal-0 probe). EPERM means
+    the process EXISTS (owned by another user) — treating it as dead
+    would orphan a live controller."""
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
 
 
 def run_in_parallel(fn: Callable[[T], R], args: Iterable[T],

@@ -1,0 +1,229 @@
+"""Controller lifecycle via the head daemon's periodic events
+(round-2 verdict #3; reference: sky/skylet/events.py:32-295 —
+JobSchedulerEvent / ServiceUpdateEvent every 20s + controller autostop
+via CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP, sky/skylet/constants.py:284).
+
+All three tests drive the REAL daemon process running on the fake
+controller VM (started by the provision path) — no client-side calls
+perform the recovery being asserted.
+"""
+import glob
+import os
+import signal
+import socket
+import sqlite3
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import global_user_state
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.utils import controller_utils
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _kill_universe_processes() -> None:
+    """SIGKILL every daemon / jobs controller / serve controller spawned
+    inside this test's SKYT_HOME universe (and nested VM universes).
+    Without this, leaked 1s-loop daemons keep respawning controllers for
+    their (dead) universe after the test ends and fight later tests for
+    ports/state."""
+    home = os.environ.get('SKYT_HOME')
+    if not home:
+        return
+    pids = set()
+    # All pidfiles in the universe: VM daemons (daemon.pid) and job
+    # processes (run-rank*.pid), including nested VM universes.
+    for pidfile in glob.glob(f'{home}/**/*.pid', recursive=True):
+        try:
+            pids.add(int(open(pidfile).read().strip()))
+        except (OSError, ValueError):
+            pass
+    for db, query in [
+            ('managed_jobs.db',
+             'SELECT controller_pid FROM managed_jobs'),
+            ('serve.db', 'SELECT controller_pid FROM services')]:
+        for path in glob.glob(f'{home}/**/{db}', recursive=True):
+            try:
+                for (pid,) in sqlite3.connect(path).execute(query):
+                    if pid:
+                        pids.add(int(pid))
+            except sqlite3.Error:
+                pass
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _fast(monkeypatch):
+    monkeypatch.setenv('SKYT_JOBS_POLL_SECONDS', '0.5')
+    monkeypatch.setenv('SKYT_JOBS_RETRY_GAP_SECONDS', '0.2')
+    monkeypatch.setenv('SKYT_SERVE_TICK_SECONDS', '1')
+    monkeypatch.setenv('SKYT_AGENT_LOOP_SECONDS', '1')
+    yield
+    _kill_universe_processes()
+
+
+def _vm_home(cluster: str) -> str:
+    return os.path.join(os.environ['SKYT_HOME'], 'fake_cloud', 'clusters',
+                        cluster, 'node0-host0', '.skyt')
+
+
+def _vm_job(job_id):
+    rows = [j for j in jobs_core.queue_all()
+            if j.get('controller') == 'vm' and j['job_id'] == job_id]
+    return rows[0] if rows else None
+
+
+def _wait_vm_job(job_id, statuses, timeout=120):
+    deadline = time.time() + timeout
+    row = None
+    while time.time() < deadline:
+        row = _vm_job(job_id)
+        if row and row['status'] in statuses:
+            return row
+        time.sleep(1.0)
+    raise TimeoutError(f'vm job {job_id} stuck at {row}')
+
+
+def test_daemon_reaps_sigkilled_jobs_controller(monkeypatch):
+    """SIGKILL the VM-side managed-job controller process: the daemon's
+    JobsSchedulerEvent must flip the job to FAILED_CONTROLLER within a
+    few event periods, with NO client submit in between (round 2: the
+    reap only ran on the next submit)."""
+    monkeypatch.setenv('SKYT_CONTROLLER_IDLE_MINUTES', '-1')
+    task = sky.Task(name='reapme', run='sleep 300')
+    task.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                         cloud='fake'))
+    job_id = jobs_core.launch(task, controller='vm')
+    _wait_vm_job(job_id, {'RUNNING'})
+
+    vm_db = os.path.join(
+        _vm_home(controller_utils.JOBS_CONTROLLER_CLUSTER),
+        'managed_jobs.db')
+    pid = sqlite3.connect(vm_db).execute(
+        'SELECT controller_pid FROM managed_jobs WHERE job_id=?',
+        (job_id,)).fetchone()[0]
+    assert pid, 'controller pid not recorded'
+    os.kill(pid, signal.SIGKILL)
+
+    # queue_all only READS the VM DB over RPC — the flip must come from
+    # the daemon event loop (1s in this test).
+    row = _wait_vm_job(job_id, {'FAILED_CONTROLLER'}, timeout=60)
+    assert row['status'] == 'FAILED_CONTROLLER'
+
+
+def test_idle_jobs_controller_vm_autostops(monkeypatch):
+    """After its last job ends, an idle controller VM stops itself
+    (reference launches controllers with idle_minutes_to_autostop=10;
+    here scaled to ~1s)."""
+    monkeypatch.setenv('SKYT_CONTROLLER_IDLE_MINUTES', '0.02')
+    task = sky.Task(name='quick', run='echo done')
+    task.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                         cloud='fake'))
+    job_id = jobs_core.launch(task, controller='vm')
+    _wait_vm_job(job_id, {'SUCCEEDED'})
+
+    cname = controller_utils.JOBS_CONTROLLER_CLUSTER
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        records = core.status([cname], refresh=True)
+        if records and records[0]['status'] == \
+                global_user_state.ClusterStatus.STOPPED:
+            return
+        time.sleep(1.0)
+    raise AssertionError(
+        f'controller VM never autostopped: {core.status([cname])}')
+
+
+def test_daemon_restarts_dead_serve_controller(monkeypatch):
+    """SIGKILL the VM-side per-service controller process: the daemon's
+    ServeControllerEvent must respawn it from the registered task_yaml;
+    the restarted controller adopts the existing replica (no leak, no
+    second replica cluster) and the service returns to READY."""
+    monkeypatch.setenv('SKYT_CONTROLLER_IDLE_MINUTES', '-1')
+    port = _free_port()
+    run = (
+        'python3 -c "\n'
+        'import http.server, os\n'
+        f"port = int(os.environ.get('SKYT_REPLICA_PORT', {port}))\n"
+        'class H(http.server.BaseHTTPRequestHandler):\n'
+        '    def do_GET(self):\n'
+        '        self.send_response(200); self.end_headers()\n'
+        "        self.wfile.write(b'restart-ok')\n"
+        '    def log_message(self, *a): pass\n'
+        "http.server.HTTPServer(('127.0.0.1', port), H).serve_forever()\n"
+        '"\n')
+    task = sky.Task(name='restartsvc', run=run)
+    task.set_resources(sky.Resources.new(accelerators='tpu-v5e-1',
+                                         cloud='fake'))
+    from skypilot_tpu.serve.service_spec import SkyServiceSpec
+    task.service = SkyServiceSpec.from_yaml_config({
+        'readiness_probe': {'path': '/', 'initial_delay_seconds': 40},
+        'replicas': 1, 'ports': port})
+    serve_core.up(task, controller='vm')
+
+    def _vm_svc():
+        svcs = [s for s in serve_core.status_all()
+                if s.get('controller') == 'vm'
+                and s['name'] == 'restartsvc']
+        return svcs[0] if svcs else None
+
+    def _wait_ready(timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            svc = _vm_svc()
+            if svc and svc['status'] == 'READY':
+                return svc
+            time.sleep(1.0)
+        raise TimeoutError(f'service stuck at {_vm_svc()}')
+
+    svc = _wait_ready()
+    old_pid = svc['controller_pid']
+    old_replicas = {r['replica_id']: r['cluster_name']
+                    for r in svc['replicas']}
+    assert old_pid and old_replicas
+    os.kill(old_pid, signal.SIGKILL)
+
+    # Daemon respawns the controller; it must adopt the SAME replica.
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        svc = _vm_svc()
+        if (svc and svc['controller_pid']
+                and svc['controller_pid'] != old_pid
+                and svc['status'] == 'READY'):
+            break
+        time.sleep(1.0)
+    else:
+        raise AssertionError(f'controller never respawned: {_vm_svc()}')
+    new_replicas = {r['replica_id']: r['cluster_name']
+                    for r in svc['replicas']}
+    assert new_replicas == old_replicas, (
+        f'replicas not adopted: {old_replicas} -> {new_replicas}')
+    # Endpoint serves again through the adopted replica (allow a few
+    # 503s while the readiness probe settles after the churn).
+    endpoint = svc['endpoint']
+    deadline = time.time() + 30
+    while True:
+        try:
+            with urllib.request.urlopen(f'http://{endpoint}/',
+                                        timeout=10) as r:
+                assert r.read() == b'restart-ok'
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 503 or time.time() > deadline:
+                raise
+            time.sleep(1.0)
+    serve_core.vm_down('restartsvc')
