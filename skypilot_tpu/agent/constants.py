@@ -15,6 +15,7 @@ CLUSTER_INFO = f'{AGENT_HOME}/cluster_info.json'
 JOBS_DIR = f'{AGENT_HOME}/jobs'
 LOGS_DIR = f'{AGENT_HOME}/logs'
 AUTOSTOP_CONFIG = f'{AGENT_HOME}/autostop.json'
+DAEMON_HEARTBEAT = f'{AGENT_HOME}/daemon.hb'
 WORKDIR = '~/sky_workdir'
 # Where the framework source is synced on every host (reference rsyncs a
 # built wheel, backends/wheel_utils.py; we rsync the package source).

@@ -141,10 +141,16 @@ def check_autostop() -> None:
     cfg = _read_json(constants.AUTOSTOP_CONFIG)
     if not cfg or cfg.get('idle_minutes', -1) < 0:
         return
-    if not job_lib.is_idle():
-        return
+    busy_marker = os.path.expanduser(
+        f'{constants.AGENT_HOME}/last_busy')
     ctrl_busy, ctrl_last = _controller_activity()
-    if ctrl_busy:
+    if not job_lib.is_idle() or ctrl_busy:
+        # Stamp the busy->idle transition: when the last service is
+        # torn down (serve rows leave no end-time behind, unlike
+        # managed jobs), idleness must count from NOW, not from the
+        # boot marker hours ago.
+        with open(busy_marker, 'w') as f:
+            f.write(str(time.time()))
         return
     last = job_lib.last_activity_time()
     boot_marker = os.path.expanduser(f'{constants.AGENT_HOME}/started_at')
@@ -158,6 +164,8 @@ def check_autostop() -> None:
             last = float(f.read().strip() or 0)
     if ctrl_last is not None:
         last = max(last, ctrl_last)
+    if os.path.exists(busy_marker):
+        last = max(last, os.path.getmtime(busy_marker))
     idle_minutes = (time.time() - last) / 60.0
     if idle_minutes < cfg['idle_minutes']:
         return
@@ -231,6 +239,24 @@ def check_serve_controllers() -> None:
                       flush=True)
                 serve_state.set_service(
                     name, status=serve_state.ServiceStatus.FAILED)
+                # Tear down the service's replica clusters: FAILED is
+                # terminal (no prober, no LB), and it no longer pins
+                # the VM awake — leaving replicas up would leak real
+                # billed VMs forever (same direct-cleanup serve down
+                # uses when the controller is gone).
+                from skypilot_tpu import core as core_lib
+                from skypilot_tpu import global_user_state
+                for replica in serve_state.get_replicas(name):
+                    if global_user_state.get_cluster(
+                            replica['cluster_name']):
+                        try:
+                            core_lib.down(replica['cluster_name'])
+                        except Exception as e:  # noqa: BLE001
+                            print(f'[daemon] replica cleanup '
+                                  f'{replica["cluster_name"]}: {e}',
+                                  flush=True)
+                    serve_state.remove_replica(name,
+                                               replica['replica_id'])
                 continue
             _serve_restarts[name] = restarts + 1
             from skypilot_tpu.serve import core as serve_core
@@ -250,7 +276,16 @@ def main() -> None:
     os.makedirs(os.path.dirname(marker), exist_ok=True)
     with open(marker, 'w') as f:
         f.write(str(time.time()))
+    hb = os.path.expanduser(constants.DAEMON_HEARTBEAT)
     while True:
+        # Liveness heartbeat, read by the client's status refresh
+        # (core._refresh_one): cloud-RUNNING + stale heartbeat = the
+        # runtime is sick even though the VMs are up -> INIT.
+        try:
+            with open(hb, 'w') as f:
+                f.write(f'{int(time.time())}\n')
+        except OSError:
+            pass
         for event in EVENTS:
             try:
                 event()

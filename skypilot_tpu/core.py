@@ -23,14 +23,61 @@ def _get_handle(cluster_name: str) -> ClusterHandle:
     return record['handle']
 
 
+def _agent_healthy(handle: ClusterHandle) -> bool:
+    """Probe the head daemon's liveness heartbeat over the cluster's
+    runner (reference: the ray-cluster health check folded into
+    _update_cluster_status_no_lock, backend_utils.py:1929). The daemon
+    rewrites ~/.skyt_agent/daemon.hb every event-loop tick; a stale or
+    missing file with the VMs still RUNNING means the on-cluster runtime
+    is dead — the cluster cannot run jobs even though the cloud reports
+    it up."""
+    import os
+    stale_after = float(os.environ.get(
+        'SKYT_AGENT_HEARTBEAT_STALE_SECONDS', '90'))
+    from skypilot_tpu.agent import constants as agent_constants
+    probe = (
+        'python3 -c "import os,time; '
+        'p=os.path.expanduser('
+        f"'{agent_constants.DAEMON_HEARTBEAT}'); "
+        "print('HB_AGE:%d' % (time.time()-os.path.getmtime(p)) "
+        "if os.path.exists(p) else 'HB_AGE:-1')\"")
+    # A SUCCESSFUL probe reporting a stale/missing heartbeat is
+    # definitive. A FAILED probe (SSH blip) is retried: a single
+    # transient failure must not flip UP->INIT — the managed-jobs
+    # controller treats a non-UP cluster as preempted and would tear
+    # down and relaunch a healthy cluster (jobs/controller.py
+    # _cluster_alive).
+    import time as time_lib
+    for attempt in range(3):
+        try:
+            rc, out, _ = handle.head_runner().run(probe,
+                                                  require_outputs=True,
+                                                  timeout=30)
+        except Exception:  # noqa: BLE001 — head unreachable; retry
+            rc, out = 1, ''
+        if rc == 0:
+            for line in out.splitlines():
+                if line.startswith('HB_AGE:'):
+                    age = float(line[len('HB_AGE:'):])
+                    return 0 <= age <= stale_after
+            return False
+        if attempt < 2:
+            time_lib.sleep(2)
+    return False
+
+
 def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
-    """Reconcile DB state with the cloud (reference:
-    _update_cluster_status_no_lock, backend_utils.py:1929 + the state
-    machine in design_docs/cluster_status.md):
-      * all instances RUNNING -> keep/mark UP
+    """Reconcile DB state with the cloud AND the on-cluster runtime
+    (reference: _update_cluster_status_no_lock, backend_utils.py:1929 +
+    the state machine in design_docs/cluster_status.md):
+      * all instances RUNNING + agent heartbeat fresh -> keep/mark UP
+      * all RUNNING but agent dead/stale (past an INIT grace period
+        after launch) -> INIT (provisioned but not operational)
       * any STOPPED           -> STOPPED (whole cluster must be stopped)
       * none found            -> cluster is gone; drop the record
     """
+    import os
+    import time as time_lib
     handle: Optional[ClusterHandle] = record['handle']
     if handle is None:
         return record
@@ -49,6 +96,17 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
     values = set(statuses.values())
     if values == {provision_common.InstanceStatus.RUNNING}:
         new_status = global_user_state.ClusterStatus.UP
+        # Health layer: VMs up but runtime dead -> INIT. A grace period
+        # after launch keeps a just-provisioned cluster (daemon not yet
+        # started / first heartbeat pending) from flapping.
+        grace = float(os.environ.get('SKYT_INIT_GRACE_SECONDS', '120'))
+        past_grace = (time_lib.time() - (record['launched_at'] or 0)
+                      > grace)
+        if past_grace and not _agent_healthy(handle):
+            logger.warning(f'Cluster {name!r}: instances RUNNING but the '
+                           'agent daemon heartbeat is stale/missing; '
+                           'marking INIT (restart with `skyt start`).')
+            new_status = global_user_state.ClusterStatus.INIT
     elif provision_common.InstanceStatus.STOPPED in values:
         new_status = global_user_state.ClusterStatus.STOPPED
     else:

@@ -20,7 +20,6 @@ scheduler state.
 from __future__ import annotations
 
 import contextlib
-import fcntl
 import os
 import subprocess
 import sys
@@ -30,6 +29,7 @@ from typing import Iterator
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import subprocess_utils
 from skypilot_tpu.utils.subprocess_utils import pid_alive as _pid_alive
 
 logger = sky_logging.init_logger(__name__)
@@ -47,15 +47,9 @@ def max_parallel_jobs() -> int:
                                      2 * (os.cpu_count() or 4)))
 
 
-@contextlib.contextmanager
-def _lock() -> Iterator[None]:
-    path = str(config_lib.home_dir() / '.jobs_scheduler.lock')
-    with open(path, 'w') as f:
-        fcntl.flock(f, fcntl.LOCK_EX)
-        try:
-            yield
-        finally:
-            fcntl.flock(f, fcntl.LOCK_UN)
+def _lock():
+    return subprocess_utils.file_lock(
+        str(config_lib.home_dir() / '.jobs_scheduler.lock'))
 
 
 def _reclaim_dead_slots() -> None:

@@ -18,8 +18,6 @@ plumbing for that recursion:
 """
 from __future__ import annotations
 
-import contextlib
-import fcntl
 import json
 import os
 import re
@@ -185,20 +183,15 @@ def controller_autostop_minutes() -> float:
         CONTROLLER_IDLE_MINUTES_TO_AUTOSTOP))
 
 
-@contextlib.contextmanager
 def _launch_lock(cluster_name: str):
     """Serialize concurrent ensure_controller_cluster calls: two racing
     `--controller vm` submits must not both see no-UP-record and launch
     the same cluster name twice (reference serializes via per-cluster
     file locks, sky/backends/backend_utils.py)."""
     from skypilot_tpu import config as config_lib
-    path = str(config_lib.home_dir() / f'.launch_{cluster_name}.lock')
-    with open(path, 'w') as f:
-        fcntl.flock(f, fcntl.LOCK_EX)
-        try:
-            yield
-        finally:
-            fcntl.flock(f, fcntl.LOCK_UN)
+    from skypilot_tpu.utils import subprocess_utils
+    return subprocess_utils.file_lock(
+        str(config_lib.home_dir() / f'.launch_{cluster_name}.lock'))
 
 
 def ensure_controller_cluster(cluster_name: str,
@@ -214,6 +207,16 @@ def ensure_controller_cluster(cluster_name: str,
     from skypilot_tpu import execution
     with _launch_lock(cluster_name):
         record = global_user_state.get_cluster(cluster_name)
+        if (record is not None and record['handle'] is not None
+                and record['status']
+                == global_user_state.ClusterStatus.UP):
+            # The controller VM autostops itself from the inside
+            # (daemon AutostopEvent), which cannot update THIS client's
+            # DB — reconcile before trusting UP, or every submit after
+            # an autostop would RPC a stopped VM and fail.
+            from skypilot_tpu import core
+            refreshed = core.status([cluster_name], refresh=True)
+            record = refreshed[0] if refreshed else None
         if (record is not None and record['handle'] is not None
                 and record['status']
                 == global_user_state.ClusterStatus.UP):

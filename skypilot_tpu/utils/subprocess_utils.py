@@ -2,11 +2,26 @@
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
+import fcntl
 import os
-from typing import Callable, Iterable, List, Optional, TypeVar
+from typing import Callable, Iterable, Iterator, List, Optional, TypeVar
 
 T = TypeVar('T')
 R = TypeVar('R')
+
+
+@contextlib.contextmanager
+def file_lock(path: str) -> Iterator[None]:
+    """Exclusive inter-process flock on `path` (reference: the filelock
+    wrappers around scheduler/cluster state, sky/jobs/scheduler.py:73,
+    sky/backends/backend_utils.py)."""
+    with open(path, 'w') as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
 
 
 def pid_alive(pid: Optional[int]) -> bool:

@@ -138,14 +138,26 @@ def test_idle_jobs_controller_vm_autostops(monkeypatch):
 
     cname = controller_utils.JOBS_CONTROLLER_CLUSTER
     deadline = time.time() + 60
+    stopped = False
     while time.time() < deadline:
         records = core.status([cname], refresh=True)
         if records and records[0]['status'] == \
                 global_user_state.ClusterStatus.STOPPED:
-            return
+            stopped = True
+            break
         time.sleep(1.0)
-    raise AssertionError(
+    assert stopped, (
         f'controller VM never autostopped: {core.status([cname])}')
+    # A later submit must notice the stopped VM (the client DB still
+    # says UP — the VM stopped itself from the inside) and restart it
+    # instead of RPCing a stopped cluster.
+    task2 = sky.Task(name='revive', run='echo revived')
+    task2.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',
+                                          cloud='fake'))
+    job2 = jobs_core.launch(task2, controller='vm')
+    row = _wait_vm_job(job2, {'SUCCEEDED', 'FAILED', 'FAILED_CONTROLLER'},
+                       timeout=120)
+    assert row['status'] == 'SUCCEEDED', row
 
 
 def test_daemon_restarts_dead_serve_controller(monkeypatch):

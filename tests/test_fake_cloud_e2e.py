@@ -241,3 +241,45 @@ def test_autostop_daemon_event(monkeypatch):
     monkeypatch.delenv('HOME')
     # Cluster gone at the provider; status refresh notices.
     assert core.status(['auto'], refresh=True) == []
+
+
+def test_status_detects_dead_agent_daemon(monkeypatch):
+    """Health-aware refresh (reference: ray-health folded into
+    backend_utils.py:1929): instances RUNNING but the head daemon dead ->
+    status flips UP -> INIT within one refresh; a fresh heartbeat keeps
+    it UP."""
+    import signal
+    monkeypatch.setenv('SKYT_AGENT_LOOP_SECONDS', '1')
+    monkeypatch.setenv('SKYT_INIT_GRACE_SECONDS', '0')
+    monkeypatch.setenv('SKYT_AGENT_HEARTBEAT_STALE_SECONDS', '5')
+    sky.launch(_task('true'), cluster_name='health', quiet_optimizer=True)
+    # Healthy: daemon heartbeat fresh -> UP survives the probe.
+    deadline = time.time() + 30
+    while True:
+        [rec] = core.status(['health'], refresh=True)
+        if rec['status'] == global_user_state.ClusterStatus.UP:
+            break
+        assert time.time() < deadline, f"never UP: {rec['status']}"
+        time.sleep(0.5)
+    # Kill the daemon out-of-band; cloud still reports RUNNING.
+    pidfile = (f"{os.environ['SKYT_HOME']}/fake_cloud/clusters/health/"
+               'node0-host0/.skyt_agent/daemon.pid')
+    os.kill(int(open(pidfile).read().strip()), signal.SIGKILL)
+    deadline = time.time() + 30
+    while True:
+        [rec] = core.status(['health'], refresh=True)
+        if rec['status'] == global_user_state.ClusterStatus.INIT:
+            break
+        assert time.time() < deadline, (
+            f"stayed {rec['status']} with a dead daemon")
+        time.sleep(1.0)
+    # `skyt start` revives the runtime (restarts the daemon) -> UP again.
+    core.start('health')
+    deadline = time.time() + 30
+    while True:
+        [rec] = core.status(['health'], refresh=True)
+        if rec['status'] == global_user_state.ClusterStatus.UP:
+            break
+        assert time.time() < deadline, 'start did not restore UP'
+        time.sleep(0.5)
+    core.down('health')
