@@ -204,6 +204,25 @@ def check_jobs_scheduler() -> None:
 # ServeControllerEvent
 # --------------------------------------------------------------------- #
 
+def _reap_replicas(serve_state, name: str) -> None:
+    """Terminate a FAILED service's replica clusters. The record is
+    removed only after a SUCCESSFUL teardown — a transient cloud error
+    keeps the row so the next tick retries instead of permanently
+    leaking a billed VM."""
+    from skypilot_tpu import core as core_lib
+    from skypilot_tpu import global_user_state
+    for replica in serve_state.get_replicas(name):
+        cluster = replica['cluster_name']
+        if global_user_state.get_cluster(cluster):
+            try:
+                core_lib.down(cluster)
+            except Exception as e:  # noqa: BLE001 — retry next tick
+                print(f'[daemon] replica cleanup {cluster}: {e}',
+                      flush=True)
+                continue
+        serve_state.remove_replica(name, replica['replica_id'])
+
+
 def check_serve_controllers() -> None:
     """Respawn dead service-controller processes (crash, OOM, reboot);
     after MAX_SERVE_RESTARTS consecutive deaths, mark the service FAILED
@@ -215,12 +234,15 @@ def check_serve_controllers() -> None:
         from skypilot_tpu.serve import state as serve_state
         for svc in serve_state.get_services():
             name = svc['name']
-            # FAILED is terminal (a crash-looped service must not be
-            # resurrected after a daemon restart resets the in-memory
-            # counter); SHUTTING_DOWN is mid-teardown.
-            if svc['status'] in (
-                    serve_state.ServiceStatus.SHUTTING_DOWN.value,
-                    serve_state.ServiceStatus.FAILED.value):
+            if svc['status'] == \
+                    serve_state.ServiceStatus.SHUTTING_DOWN.value:
+                continue
+            if svc['status'] == serve_state.ServiceStatus.FAILED.value:
+                # Terminal (a crash-looped service must not be
+                # resurrected after a daemon restart resets the
+                # in-memory counter) — but keep reaping any replicas
+                # whose teardown failed on an earlier tick.
+                _reap_replicas(serve_state, name)
                 continue
             if _pid_alive(svc['controller_pid']):
                 _serve_restarts.pop(name, None)
@@ -244,19 +266,7 @@ def check_serve_controllers() -> None:
                 # the VM awake — leaving replicas up would leak real
                 # billed VMs forever (same direct-cleanup serve down
                 # uses when the controller is gone).
-                from skypilot_tpu import core as core_lib
-                from skypilot_tpu import global_user_state
-                for replica in serve_state.get_replicas(name):
-                    if global_user_state.get_cluster(
-                            replica['cluster_name']):
-                        try:
-                            core_lib.down(replica['cluster_name'])
-                        except Exception as e:  # noqa: BLE001
-                            print(f'[daemon] replica cleanup '
-                                  f'{replica["cluster_name"]}: {e}',
-                                  flush=True)
-                    serve_state.remove_replica(name,
-                                               replica['replica_id'])
+                _reap_replicas(serve_state, name)
                 continue
             _serve_restarts[name] = restarts + 1
             from skypilot_tpu.serve import core as serve_core
@@ -276,16 +286,26 @@ def main() -> None:
     os.makedirs(os.path.dirname(marker), exist_ok=True)
     with open(marker, 'w') as f:
         f.write(str(time.time()))
+    # Liveness heartbeat, read by the client's status refresh
+    # (core._refresh_one): cloud-RUNNING + stale heartbeat = the runtime
+    # is sick even though the VMs are up -> INIT. Written from its own
+    # thread so a long-blocking event (cloud teardown in
+    # check_serve_controllers can take minutes) does not make a healthy
+    # daemon look dead.
     hb = os.path.expanduser(constants.DAEMON_HEARTBEAT)
+
+    def _beat():
+        while True:
+            try:
+                with open(hb, 'w') as f:
+                    f.write(f'{int(time.time())}\n')
+            except OSError:
+                pass
+            time.sleep(min(LOOP_SECONDS, 10.0))
+
+    import threading
+    threading.Thread(target=_beat, daemon=True).start()
     while True:
-        # Liveness heartbeat, read by the client's status refresh
-        # (core._refresh_one): cloud-RUNNING + stale heartbeat = the
-        # runtime is sick even though the VMs are up -> INIT.
-        try:
-            with open(hb, 'w') as f:
-                f.write(f'{int(time.time())}\n')
-        except OSError:
-            pass
         for event in EVENTS:
             try:
                 event()

@@ -107,9 +107,13 @@ def cli():
 @click.option('--down', is_flag=True,
               help='Tear down the cluster when the job finishes.')
 @click.option('--yes', '-y', is_flag=True)
+@click.option('--retry-until-up', is_flag=True,
+              help='Keep retrying the failover sweep (with backoff) '
+                   'until capacity is found.')
 @_apply_resource_opts
 def launch(entrypoint, cluster, env, detach_run, dryrun, down, yes,
-           accelerators, cloud, region, zone, use_spot, cpus, num_nodes):
+           retry_until_up, accelerators, cloud, region, zone, use_spot,
+           cpus, num_nodes):
     """Provision (or reuse) a cluster and run ENTRYPOINT (YAML or cmd)."""
     import skypilot_tpu as sky
     from skypilot_tpu import dag as dag_lib, optimizer
@@ -124,7 +128,8 @@ def launch(entrypoint, cluster, env, detach_run, dryrun, down, yes,
         click.confirm('Launch?', abort=True, default=True)
     job_id, handle = sky.launch(task, cluster_name=cluster, dryrun=dryrun,
                                 detach_run=detach_run, down=down,
-                                quiet_optimizer=True)
+                                quiet_optimizer=True,
+                                retry_until_up=retry_until_up)
     if handle is not None and job_id is not None:
         print(f'Job {job_id} on cluster {handle.cluster_name!r}. '
               f'Logs: skyt logs {handle.cluster_name} {job_id}')

@@ -48,11 +48,12 @@ def _agent_healthy(handle: ClusterHandle) -> bool:
     # down and relaunch a healthy cluster (jobs/controller.py
     # _cluster_alive).
     import time as time_lib
+    probe_timeout = float(os.environ.get(
+        'SKYT_AGENT_PROBE_TIMEOUT_SECONDS', '10'))
     for attempt in range(3):
         try:
-            rc, out, _ = handle.head_runner().run(probe,
-                                                  require_outputs=True,
-                                                  timeout=30)
+            rc, out, _ = handle.head_runner().run(
+                probe, require_outputs=True, timeout=probe_timeout)
         except Exception:  # noqa: BLE001 — head unreachable; retry
             rc, out = 1, ''
         if rc == 0:
@@ -62,7 +63,7 @@ def _agent_healthy(handle: ClusterHandle) -> bool:
                     return 0 <= age <= stale_after
             return False
         if attempt < 2:
-            time_lib.sleep(2)
+            time_lib.sleep(1)
     return False
 
 
@@ -125,7 +126,11 @@ def status(cluster_names: Optional[List[str]] = None,
     if cluster_names is not None:
         records = [r for r in records if r['name'] in cluster_names]
     if refresh:
-        records = [_refresh_one(r) for r in records]
+        # Parallel: each refresh may probe the head over SSH (worst
+        # case ~30s for an unreachable host); serial would make `skyt
+        # status -r` scale with cluster count x probe time.
+        from skypilot_tpu.utils import subprocess_utils
+        records = subprocess_utils.run_in_parallel(_refresh_one, records)
         records = [r for r in records if r['status'] is not None]
     return records
 

@@ -43,10 +43,15 @@ class ResourcesUnavailableError(SkyTpuError):
 
     def __init__(self, message: str,
                  failover_history: Optional[List[Exception]] = None,
-                 no_failover: bool = False) -> None:
+                 no_failover: bool = False,
+                 retryable: bool = False) -> None:
         super().__init__(message)
         self.failover_history = failover_history or []
         self.no_failover = no_failover
+        # True only for transient exhaustion (every candidate stocked
+        # out) — the case `--retry-until-up` may retry. Infeasible
+        # requests and cloud-level (auth/config) failures stay fatal.
+        self.retryable = retryable
 
 
 class ResourcesMismatchError(SkyTpuError):

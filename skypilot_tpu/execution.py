@@ -141,23 +141,48 @@ def launch(task: Union[task_lib.Task, dag_lib.Dag],
            detach_run: bool = False,
            down: bool = False,
            quiet_optimizer: bool = False,
-           avoid_zones: Optional[List[str]] = None
+           avoid_zones: Optional[List[str]] = None,
+           retry_until_up: bool = False
            ) -> Tuple[Optional[int], Optional[ClusterHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
 
     Reference: sky.launch (execution.py:369). Returns (job_id, handle).
     `avoid_zones` deprioritizes zones in failover ordering (used by
     managed-jobs recovery after a preemption).
+
+    `retry_until_up` keeps retrying the whole failover sweep with
+    exponential backoff when EVERY candidate is stocked out (reference:
+    `sky launch --retry-until-up`). TPU stockouts are the normal case,
+    not the edge case — without this, a fully exhausted sweep fails
+    permanently even though capacity frees up minutes later.
     """
+    import os
+    import time
     stages = [Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
               Stage.SYNC_STORAGE, Stage.SYNC_FILE_MOUNTS, Stage.PRE_EXEC,
               Stage.EXEC]
     if down:
         stages.append(Stage.DOWN)
-    return _execute(task, cluster_name, stages, dryrun=dryrun,
-                    detach_run=detach_run, down=down,
-                    quiet_optimizer=quiet_optimizer,
-                    avoid_zones=avoid_zones)
+    gap = float(os.environ.get('SKYT_RETRY_UNTIL_UP_GAP_SECONDS', '30'))
+    max_gap = float(os.environ.get(
+        'SKYT_RETRY_UNTIL_UP_MAX_GAP_SECONDS', '300'))
+    while True:
+        try:
+            return _execute(task, cluster_name, stages, dryrun=dryrun,
+                            detach_run=detach_run, down=down,
+                            quiet_optimizer=quiet_optimizer,
+                            avoid_zones=avoid_zones)
+        except exceptions.ResourcesUnavailableError as e:
+            # Only transient exhaustion (all candidates stocked out) is
+            # worth retrying; an infeasible request or a cloud-level
+            # auth/config failure would loop forever.
+            if not retry_until_up or not getattr(e, 'retryable', False):
+                raise
+            logger.warning(
+                f'All candidates exhausted ({e}); retrying in '
+                f'{gap:.0f}s (--retry-until-up).')
+            time.sleep(gap)
+            gap = min(gap * 2, max_gap)
 
 
 @usage_lib.entrypoint

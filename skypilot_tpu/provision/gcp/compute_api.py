@@ -134,8 +134,14 @@ def open_ports(project: str, cluster_name: str, ports: List[int],
         client.request(
             'POST', f'{_BASE}/projects/{project}/global/firewalls', body)
     except client.GcpApiError as e:
-        if e.status != 409:  # already exists is fine
+        if e.status != 409:
             raise
+        # Rule exists: PATCH the allowed-ports list — the serve path
+        # re-opens the controller rule with the UNION of live service
+        # ports, so an update must actually land, not be swallowed.
+        client.request(
+            'PATCH', f'{_BASE}/projects/{project}/global/firewalls/'
+            f'{_firewall_name(cluster_name)}', body)
 
 
 def cleanup_ports(project: str, cluster_name: str) -> None:

@@ -107,7 +107,7 @@ def provision_with_failover(
         f'Failed to provision {cluster_name!r} in all candidate zones '
         f'({len(failures)} attempts). Errors: '
         + '; '.join(str(f) for f in failures[-3:]),
-        failover_history=failures)
+        failover_history=failures, retryable=True)
 
 
 # --------------------------------------------------------------------- #

@@ -110,6 +110,7 @@ def _up_on_controller_vm(task: task_lib.Task, name: str) -> str:
     result = controller_utils.rpc(
         handle, 'skypilot_tpu.serve.rpc',
         ['up', '--service-name', name, '--task-yaml', remote_yaml])
+    _sync_controller_ports(handle, extra_ports=[task.service.port])
     head = handle.cluster_info.head_instance
     ip = head.external_ip or head.internal_ip
     logger.info(f"Service {name!r} starting on controller cluster "
@@ -165,6 +166,36 @@ def _vm_handle():
         controller_utils.SERVE_CONTROLLER_CLUSTER)
 
 
+def _sync_controller_ports(handle, extra_ports=()) -> None:
+    """Reconcile the controller VM's firewall with the union of live
+    service LB ports (reference threads task ports through resources to
+    the provisioner, sky/provision/__init__.py:120-160; the controller
+    VM hosts many services on one cluster, so ports are opened per-up
+    and re-unioned on every change rather than at boot)."""
+    from skypilot_tpu import provision
+    from skypilot_tpu.utils import controller_utils
+    cluster = controller_utils.SERVE_CONTROLLER_CLUSTER
+    try:
+        vm_svcs = controller_utils.rpc(handle, 'skypilot_tpu.serve.rpc',
+                                       ['status'])
+        ports = sorted({int(s['endpoint'].rsplit(':', 1)[-1])
+                        for s in vm_svcs if s.get('endpoint')}
+                       # A just-upped service has no endpoint row yet
+                       # (its controller is still booting) — its port is
+                       # passed explicitly.
+                       | {int(p) for p in extra_ports})
+        cfg = getattr(handle, 'provider_config', {}) or {}
+        if ports:
+            provision.open_ports(handle.cloud, cluster, ports, cfg)
+        else:
+            provision.cleanup_ports(handle.cloud, cluster, [], cfg)
+    except Exception as e:  # noqa: BLE001 — best-effort: the provider's
+        # firewall API raises its own types (e.g. GcpApiError, not
+        # SkyTpuError); a failed sync must not fail a serve op that
+        # already succeeded on the controller VM.
+        logger.warning(f'could not sync controller firewall ports: {e}')
+
+
 def status_all(service_name: Optional[str] = None
                ) -> List[Dict[str, Any]]:
     """Local services + the serve controller cluster's services (over
@@ -199,6 +230,7 @@ def vm_down(service_name: str) -> None:
     controller_utils.rpc(handle, 'skypilot_tpu.serve.rpc',
                          ['down', '--service-name', service_name],
                          timeout=180)
+    _sync_controller_ports(handle)
 
 
 def vm_update(service_name: str, task: task_lib.Task) -> int:
@@ -225,6 +257,7 @@ def vm_update(service_name: str, task: task_lib.Task) -> int:
         handle, 'skypilot_tpu.serve.rpc',
         ['update', '--service-name', service_name,
          '--task-yaml', remote_yaml])
+    _sync_controller_ports(handle, extra_ports=[task.service.port])
     return result['version']
 
 

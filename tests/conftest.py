@@ -23,8 +23,47 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest
 
 
+def _kill_universe_processes(home: str) -> None:
+    """SIGKILL every daemon / job process / controller recorded inside a
+    test's SKYT_HOME universe (including nested VM universes). Leaked
+    daemons tick forever (1s loops in lifecycle tests) and keep
+    respawning controllers that fight later tests for ports."""
+    import glob
+    import signal
+    import sqlite3
+    pids = set()
+    for pidfile in glob.glob(f'{home}/**/*.pid', recursive=True):
+        try:
+            pids.add(int(open(pidfile).read().strip()))
+        except (OSError, ValueError):
+            pass
+    for db, query in [
+            ('managed_jobs.db', 'SELECT controller_pid FROM managed_jobs'),
+            ('serve.db', 'SELECT controller_pid FROM services')]:
+        for path in glob.glob(f'{home}/**/{db}', recursive=True):
+            try:
+                for (pid,) in sqlite3.connect(path).execute(query):
+                    if pid:
+                        pids.add(int(pid))
+            except sqlite3.Error:
+                pass
+    for pid in pids:
+        # Job pidfiles record a setsid process-group leader; killing
+        # only the leader leaves grandchildren (replica HTTP servers)
+        # holding their ports.
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
 @pytest.fixture(autouse=True)
 def _hermetic_state(tmp_path, monkeypatch):
-    monkeypatch.setenv('SKYT_HOME', str(tmp_path / 'skyt_home'))
+    home = str(tmp_path / 'skyt_home')
+    monkeypatch.setenv('SKYT_HOME', home)
     monkeypatch.setenv('SKYT_ENABLE_FAKE_CLOUD', '1')
     yield
+    _kill_universe_processes(home)

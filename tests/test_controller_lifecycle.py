@@ -7,7 +7,6 @@ All three tests drive the REAL daemon process running on the fake
 controller VM (started by the provision path) — no client-side calls
 perform the recovery being asserted.
 """
-import glob
 import os
 import signal
 import socket
@@ -31,49 +30,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _kill_universe_processes() -> None:
-    """SIGKILL every daemon / jobs controller / serve controller spawned
-    inside this test's SKYT_HOME universe (and nested VM universes).
-    Without this, leaked 1s-loop daemons keep respawning controllers for
-    their (dead) universe after the test ends and fight later tests for
-    ports/state."""
-    home = os.environ.get('SKYT_HOME')
-    if not home:
-        return
-    pids = set()
-    # All pidfiles in the universe: VM daemons (daemon.pid) and job
-    # processes (run-rank*.pid), including nested VM universes.
-    for pidfile in glob.glob(f'{home}/**/*.pid', recursive=True):
-        try:
-            pids.add(int(open(pidfile).read().strip()))
-        except (OSError, ValueError):
-            pass
-    for db, query in [
-            ('managed_jobs.db',
-             'SELECT controller_pid FROM managed_jobs'),
-            ('serve.db', 'SELECT controller_pid FROM services')]:
-        for path in glob.glob(f'{home}/**/{db}', recursive=True):
-            try:
-                for (pid,) in sqlite3.connect(path).execute(query):
-                    if pid:
-                        pids.add(int(pid))
-            except sqlite3.Error:
-                pass
-    for pid in pids:
-        try:
-            os.kill(pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-
-
 @pytest.fixture(autouse=True)
 def _fast(monkeypatch):
     monkeypatch.setenv('SKYT_JOBS_POLL_SECONDS', '0.5')
     monkeypatch.setenv('SKYT_JOBS_RETRY_GAP_SECONDS', '0.2')
     monkeypatch.setenv('SKYT_SERVE_TICK_SECONDS', '1')
     monkeypatch.setenv('SKYT_AGENT_LOOP_SECONDS', '1')
-    yield
-    _kill_universe_processes()
 
 
 def _vm_home(cluster: str) -> str:

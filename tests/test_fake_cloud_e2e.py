@@ -283,3 +283,28 @@ def test_status_detects_dead_agent_daemon(monkeypatch):
         assert time.time() < deadline, 'start did not restore UP'
         time.sleep(0.5)
     core.down('health')
+
+
+def test_retry_until_up_waits_for_capacity(monkeypatch):
+    """--retry-until-up: a fully stocked-out sweep retries with backoff
+    and succeeds once capacity appears (reference: `sky launch
+    --retry-until-up`; TPU stockouts are the normal case)."""
+    import threading
+    monkeypatch.setenv('SKYT_RETRY_UNTIL_UP_GAP_SECONDS', '1')
+    zones = {z: 0 for z in
+             ('us-central1-a us-west1-c us-west4-a us-east1-c us-east5-b '
+              'europe-west4-b asia-southeast1-b').split()}
+    fake_cloud.set_capacity(zones=zones)
+
+    def _free_capacity():
+        time.sleep(3)
+        fake_cloud.set_capacity(zones={})
+
+    threading.Thread(target=_free_capacity, daemon=True).start()
+    t0 = time.time()
+    job_id, handle = sky.launch(_task('true'), cluster_name='retryup',
+                                quiet_optimizer=True, retry_until_up=True)
+    assert handle is not None and job_id is not None
+    # It actually waited through at least one stocked-out sweep.
+    assert time.time() - t0 >= 3
+    assert core.job_status('retryup', job_id) == 'SUCCEEDED'

@@ -27,6 +27,7 @@ class FakeGcpService:
         self.tpu_nodes = {}       # (zone, name) -> node dict
         self.gce = {}             # (zone, name) -> instance dict
         self.queued = {}          # (zone, name) -> qr dict
+        self.firewalls = {}       # name -> rule body
         self.stockout_zones = set(stockout_zones)
         self.quota_fail = quota_fail
         self.hosts_per_node = hosts_per_node
@@ -168,7 +169,23 @@ class FakeGcpService:
             if rest.startswith('operations/'):
                 return 200, {'status': 'DONE'}
         if rest.startswith('global/firewalls'):
-            return 200, {'status': 'DONE'}
+            parts = rest.split('/')
+            name = parts[2] if len(parts) > 2 else data.get('name')
+            if method == 'POST':
+                if name in self.firewalls:
+                    return self._err(409, 'ALREADY_EXISTS', name)
+                self.firewalls[name] = data
+                return 200, {'status': 'DONE'}
+            if method == 'PATCH':
+                if name not in self.firewalls:
+                    return self._err(404, 'NOT_FOUND', name)
+                self.firewalls[name].update(data)
+                return 200, {'status': 'DONE'}
+            if method == 'DELETE':
+                if name not in self.firewalls:
+                    return self._err(404, 'NOT_FOUND', name)
+                del self.firewalls[name]
+                return 200, {'status': 'DONE'}
         return self._err(404, 'NOT_FOUND', rest)
 
 
@@ -338,3 +355,20 @@ def test_failover_loop_with_gcp_provider(fake_gcp, monkeypatch, tmp_path):
     assert list(st.values()) == [common.InstanceStatus.RUNNING]
     gcp_instance.terminate_instances('fo', result.provider_config)
     assert not svc.tpu_nodes
+
+
+def test_open_ports_creates_then_patches_rule(fake_gcp):
+    """Re-opening with a different port set must PATCH the existing rule
+    (the serve path re-unions the controller VM's live service ports; a
+    swallowed 409 would leave new services firewalled)."""
+    svc = fake_gcp()
+    from skypilot_tpu.provision.gcp import compute_api
+    compute_api.open_ports('proj', 'c1', [8000])
+    rule = svc.firewalls['skyt-c1-ports']
+    assert rule['allowed'][0]['ports'] == ['8000']
+    compute_api.open_ports('proj', 'c1', [8000, 9001])
+    rule = svc.firewalls['skyt-c1-ports']
+    assert rule['allowed'][0]['ports'] == ['8000', '9001']
+    compute_api.cleanup_ports('proj', 'c1')
+    assert 'skyt-c1-ports' not in svc.firewalls
+    compute_api.cleanup_ports('proj', 'c1')  # idempotent on 404
