@@ -35,6 +35,7 @@ import contextlib
 import json
 import os
 import sqlite3
+import subprocess
 import time
 from typing import Dict, Optional, Tuple
 
@@ -204,23 +205,47 @@ def check_jobs_scheduler() -> None:
 # ServeControllerEvent
 # --------------------------------------------------------------------- #
 
-def _reap_replicas(serve_state, name: str) -> None:
-    """Terminate a FAILED service's replica clusters. The record is
+_reaping: Dict[str, 'subprocess.Popen'] = {}
+
+
+def _reap_replicas_sync(name: str) -> None:
+    """Terminate a FAILED service's replica clusters (runs in a reap
+    subprocess with SKYT_HOME pinned to the VM universe). A record is
     removed only after a SUCCESSFUL teardown — a transient cloud error
-    keeps the row so the next tick retries instead of permanently
+    keeps the row so a later sweep retries instead of permanently
     leaking a billed VM."""
     from skypilot_tpu import core as core_lib
     from skypilot_tpu import global_user_state
+    from skypilot_tpu.serve import state as serve_state
     for replica in serve_state.get_replicas(name):
         cluster = replica['cluster_name']
         if global_user_state.get_cluster(cluster):
             try:
                 core_lib.down(cluster)
-            except Exception as e:  # noqa: BLE001 — retry next tick
+            except Exception as e:  # noqa: BLE001 — retry next sweep
                 print(f'[daemon] replica cleanup {cluster}: {e}',
                       flush=True)
                 continue
         serve_state.remove_replica(name, replica['replica_id'])
+
+
+def _reap_replicas(serve_state, name: str) -> None:
+    """Spawn the reap in a subprocess: a real cluster teardown takes
+    minutes, and blocking the event loop would starve autostop and the
+    jobs scheduler. The subprocess gets SKYT_HOME pinned explicitly, so
+    the parent's _vm_universe restore cannot race it."""
+    import sys
+    if not serve_state.get_replicas(name):
+        return
+    prev = _reaping.get(name)
+    if prev is not None and prev.poll() is None:
+        return  # previous sweep still running
+    env = {**os.environ, 'SKYT_HOME': _vm_home()}
+    _reaping[name] = subprocess.Popen(
+        [sys.executable, '-c',
+         'from skypilot_tpu.agent import daemon; '
+         f'daemon._reap_replicas_sync({name!r})'],
+        env=env, stdin=subprocess.DEVNULL, start_new_session=True)
 
 
 def check_serve_controllers() -> None:

@@ -1,0 +1,160 @@
+"""Cloud Billing Catalog overlay for TPU prices.
+
+Reference: sky/clouds/service_catalog/data_fetchers/fetch_gcp.py pulls
+SKUs from the Cloud Billing Catalog API and then hand-patches the TPU
+gaps it documents at :34-76 (hidden v3-pod prices, missing v5/v6e SKUs).
+We keep the curated table in fetcher.py as the source of truth and treat
+the billing API as an OVERLAY: `python -m skypilot_tpu.catalog.fetcher
+--refresh` resolves the Cloud TPU billing service by display name, pages
+through its SKUs, parses (generation, region, spot?) -> $/chip-hr, and
+writes price_overlay.json, which generate_tpu_csv merges over the pinned
+numbers. Anything the API doesn't expose falls back per-cell.
+
+Auth and transport ride the same injectable client as the provisioner
+(provision/gcp/client.py), so the whole flow is unit-testable offline.
+"""
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import client
+
+logger = sky_logging.init_logger(__name__)
+
+_BASE = 'https://cloudbilling.googleapis.com/v1'
+
+# SKU-description tokens -> catalog generation names. Billing
+# descriptions have drifted across generations ("TpuV2", "Cloud TPU v4",
+# "TPU v5 Lite", "Tpu-v5p", "Trillium"), so match loosely.
+_GENERATION_PATTERNS = [
+    ('v5e', re.compile(r'v5\s*-?lite|v5e', re.I)),
+    ('v5p', re.compile(r'v5\s*-?p', re.I)),
+    ('v6e', re.compile(r'v6e|trillium', re.I)),
+    ('v4', re.compile(r'v4', re.I)),
+    ('v3', re.compile(r'v3', re.I)),
+    ('v2', re.compile(r'v2', re.I)),
+]
+
+_SPOT_RE = re.compile(r'preemptible|spot', re.I)
+
+
+def _find_tpu_service() -> str:
+    """Resolve the Cloud TPU service id by display name (the id is an
+    opaque hex tuple that Google does not document as stable)."""
+    page_token: Optional[str] = None
+    while True:
+        url = f'{_BASE}/services?pageSize=200'
+        if page_token:
+            url += f'&pageToken={page_token}'
+        resp = client.request('GET', url)
+        for svc in resp.get('services', []):
+            if 'tpu' in svc.get('displayName', '').lower():
+                return svc['name']  # 'services/XXXX-...'
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            raise client.GcpApiError(
+                404, 'NOT_FOUND',
+                'No billing service with "TPU" in its display name; '
+                'is the Cloud Billing API enabled?')
+
+
+def _unit_price_usd(sku: Dict) -> Optional[float]:
+    """Hourly USD price from a SKU's pricingInfo (units + nanos of the
+    last tiered rate — TPU SKUs are flat-rate, one tier)."""
+    infos = sku.get('pricingInfo', [])
+    if not infos:
+        return None
+    expr = infos[0].get('pricingExpression', {})
+    rates = expr.get('tieredRates', [])
+    if not rates:
+        return None
+    price = rates[-1].get('unitPrice', {})
+    return int(price.get('units', 0) or 0) + \
+        int(price.get('nanos', 0) or 0) / 1e9
+
+
+_HOUR_UNITS = {'h', 'hr', 'hour', 'hours'}
+
+
+def parse_skus(skus) -> Dict[str, Dict[str, Dict[str, float]]]:
+    """SKU list -> {generation: {region: {'od': x, 'spot': y}}}.
+
+    Only per-chip-HOUR usage SKUs count: the TPU billing service also
+    lists pod-slice, commitment (CUD), and egress SKUs whose prices
+    would be wildly wrong as $/chip-hr (the reference fetcher filters by
+    usage unit for the same reason, fetch_gcp.py). Filters:
+      * description mentions a generation token,
+      * pricingExpression.usageUnit is an hour,
+      * category.usageType is OnDemand/Preemptible/Spot (drops CUDs),
+      * no 'commitment' / 'pod slice' wording.
+    Spot = Preemptible/Spot usageType or wording.
+    """
+    out: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for sku in skus:
+        desc = sku.get('description', '')
+        gen = next((g for g, pat in _GENERATION_PATTERNS
+                    if pat.search(desc)), None)
+        if gen is None:
+            continue
+        if re.search(r'commitment|pod slice', desc, re.I):
+            continue
+        expr = (sku.get('pricingInfo') or [{}])[0].get(
+            'pricingExpression', {})
+        unit = str(expr.get('usageUnit', 'h')).lower()
+        if unit not in _HOUR_UNITS:
+            continue
+        usage_type = sku.get('category', {}).get('usageType', 'OnDemand')
+        if usage_type not in ('OnDemand', 'Preemptible', 'Spot'):
+            continue
+        price = _unit_price_usd(sku)
+        if not price:
+            continue
+        kind = ('spot' if usage_type in ('Preemptible', 'Spot')
+                or _SPOT_RE.search(desc) else 'od')
+        for region in sku.get('serviceRegions', []):
+            out.setdefault(gen, {}).setdefault(region, {})[kind] = price
+    return out
+
+
+def fetch_tpu_prices() -> Dict[str, Dict[str, Dict[str, float]]]:
+    service = _find_tpu_service()
+    skus = []
+    page_token: Optional[str] = None
+    while True:
+        url = f'{_BASE}/{service}/skus?pageSize=500'
+        if page_token:
+            url += f'&pageToken={page_token}'
+        resp = client.request('GET', url)
+        skus.extend(resp.get('skus', []))
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            break
+    return parse_skus(skus)
+
+
+def refresh_price_overlay() -> Dict[str, Dict[str, Tuple[float, float]]]:
+    """Fetch live prices and persist the overlay consumed by
+    fetcher.chip_prices(). Returns the overlay mapping. Raises
+    NoCloudAccessError without credentials — the pinned table remains in
+    effect."""
+    from skypilot_tpu.catalog import fetcher
+    parsed = fetch_tpu_prices()
+    overlay: Dict[str, Dict[str, Tuple[float, float]]] = {}
+    for gen, regions in parsed.items():
+        for region, prices in regions.items():
+            overlay.setdefault(gen, {})[region] = (
+                prices.get('od', 0.0), prices.get('spot', 0.0))
+    fetcher.PRICE_OVERLAY_PATH.parent.mkdir(parents=True, exist_ok=True)
+    fetcher.PRICE_OVERLAY_PATH.write_text(json.dumps({
+        'fetched_at': time.time(),
+        'prices': {g: {r: list(p) for r, p in regions.items()}
+                   for g, regions in overlay.items()},
+    }, indent=2))
+    logger.info(f'Wrote billing-API price overlay for '
+                f'{sum(len(v) for v in overlay.values())} '
+                f'(generation, region) pairs.')
+    return overlay

@@ -178,12 +178,18 @@ def _sync_controller_ports(handle, extra_ports=()) -> None:
     try:
         vm_svcs = controller_utils.rpc(handle, 'skypilot_tpu.serve.rpc',
                                        ['status'])
-        ports = sorted({int(s['endpoint'].rsplit(':', 1)[-1])
-                        for s in vm_svcs if s.get('endpoint')}
-                       # A just-upped service has no endpoint row yet
-                       # (its controller is still booting) — its port is
-                       # passed explicitly.
-                       | {int(p) for p in extra_ports})
+        # Union from the registered SPEC ports, not live endpoints: a
+        # sibling service still booting has no endpoint row yet, and
+        # nothing re-syncs when it later becomes READY — computing from
+        # endpoints would close its port on the next down/update.
+        ports = set()
+        for s in vm_svcs:
+            spec_ports = (s.get('spec') or {}).get('ports')
+            if spec_ports:
+                ports.add(int(spec_ports))
+            elif s.get('endpoint'):
+                ports.add(int(s['endpoint'].rsplit(':', 1)[-1]))
+        ports = sorted(ports | {int(p) for p in extra_ports})
         cfg = getattr(handle, 'provider_config', {}) or {}
         if ports:
             provision.open_ports(handle.cloud, cluster, ports, cfg)

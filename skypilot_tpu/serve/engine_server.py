@@ -195,16 +195,20 @@ class ModelServer:
         # the socket was still unbound made an immediate client connect
         # race warmup and fail with ECONNREFUSED.
         self._httpd = ThreadingServer(('0.0.0.0', self.port), Handler)
-        self._warmup()
-        loop = threading.Thread(
-            target=self.engine.run_loop,
-            args=(self.request_queue, self.stop), daemon=True)
-        loop.start()
         try:
+            self._warmup()
+            loop = threading.Thread(
+                target=self.engine.run_loop,
+                args=(self.request_queue, self.stop), daemon=True)
+            loop.start()
             self._httpd.serve_forever()
         finally:
+            # Covers warmup failures too: the socket is bound before
+            # warmup, and leaking it would EADDRINUSE the next bind in
+            # this process (long-lived test runners).
             self.stop.set()
             self.request_queue.put(None)
+            self._httpd.server_close()
 
     def shutdown(self) -> None:
         self.stop.set()

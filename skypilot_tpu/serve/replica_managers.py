@@ -66,10 +66,13 @@ class ReplicaManager:
         for row in state.get_replicas(self.service_name):
             rid = row['replica_id']
             self._next_id = max(self._next_id, rid + 1)
+            # The persisted version marks pre-update replicas so an
+            # interrupted blue-green rollout resumes after a controller
+            # restart instead of being silently dropped.
             info = ReplicaInfo(rid, row['cluster_name'],
                                self._replica_port(rid),
                                is_spot=self.spec.use_spot,
-                               version=self.version)
+                               version=row.get('version', 1))
             info.endpoint = row['endpoint']
             with self._lock:
                 self.replicas[rid] = info
@@ -120,7 +123,8 @@ class ReplicaManager:
                                version=self.version)
             self.replicas[replica_id] = info
         state.upsert_replica(self.service_name, replica_id, cluster,
-                             state.ReplicaStatus.PROVISIONING, None)
+                             state.ReplicaStatus.PROVISIONING, None,
+                             version=info.version)
         t = threading.Thread(target=self._launch_replica, args=(info,),
                              daemon=True)
         t.start()
@@ -157,7 +161,8 @@ class ReplicaManager:
             logger.warning(f'replica {info.replica_id} launch failed: {e}')
             info.status = state.ReplicaStatus.FAILED
         state.upsert_replica(self.service_name, info.replica_id,
-                             info.cluster_name, info.status, info.endpoint)
+                             info.cluster_name, info.status, info.endpoint,
+                             version=info.version)
 
     def scale_down(self, replica_id: int) -> None:
         with self._lock:
@@ -166,7 +171,8 @@ class ReplicaManager:
             return
         info.status = state.ReplicaStatus.SHUTTING_DOWN
         state.upsert_replica(self.service_name, replica_id,
-                             info.cluster_name, info.status, info.endpoint)
+                             info.cluster_name, info.status, info.endpoint,
+                             version=info.version)
         t = threading.Thread(target=self._terminate_replica, args=(info,),
                              daemon=True)
         t.start()

@@ -47,13 +47,15 @@ def _conn() -> sqlite3.Connection:
             cluster_name TEXT,
             status TEXT,
             endpoint TEXT,
+            version INTEGER DEFAULT 1,
             PRIMARY KEY (service_name, replica_id));
     """)
     # Backfill columns for DBs created before they existed (mirrors
     # jobs/state.py): CREATE TABLE IF NOT EXISTS does not alter an
     # existing table.
     for ddl in ('ALTER TABLE services ADD COLUMN version INTEGER DEFAULT 1',
-                'ALTER TABLE services ADD COLUMN task_yaml TEXT'):
+                'ALTER TABLE services ADD COLUMN task_yaml TEXT',
+                'ALTER TABLE replicas ADD COLUMN version INTEGER DEFAULT 1'):
         try:
             conn.execute(ddl)
         except sqlite3.OperationalError:
@@ -123,12 +125,14 @@ def remove_service(name: str) -> None:
 
 def upsert_replica(service: str, replica_id: int, cluster_name: str,
                    status: ReplicaStatus,
-                   endpoint: Optional[str]) -> None:
+                   endpoint: Optional[str], version: int = 1) -> None:
     with _conn() as conn:
         conn.execute(
             'INSERT OR REPLACE INTO replicas (service_name, replica_id,'
-            ' cluster_name, status, endpoint) VALUES (?,?,?,?,?)',
-            (service, replica_id, cluster_name, status.value, endpoint))
+            ' cluster_name, status, endpoint, version) VALUES '
+            '(?,?,?,?,?,?)',
+            (service, replica_id, cluster_name, status.value, endpoint,
+             version))
 
 
 def remove_replica(service: str, replica_id: int) -> None:
@@ -139,7 +143,8 @@ def remove_replica(service: str, replica_id: int) -> None:
 
 def get_replicas(service: str) -> List[Dict[str, Any]]:
     rows = _conn().execute(
-        'SELECT replica_id, cluster_name, status, endpoint FROM replicas '
-        'WHERE service_name=? ORDER BY replica_id', (service,)).fetchall()
+        'SELECT replica_id, cluster_name, status, endpoint, version '
+        'FROM replicas WHERE service_name=? ORDER BY replica_id',
+        (service,)).fetchall()
     return [{'replica_id': r[0], 'cluster_name': r[1], 'status': r[2],
-             'endpoint': r[3]} for r in rows]
+             'endpoint': r[3], 'version': r[4] or 1} for r in rows]

@@ -1,0 +1,184 @@
+"""Catalog price truth (round-2 verdict #7): pinned prices match the
+public Cloud TPU list prices, and the billing-API `--refresh` overlay
+(reference: data_fetchers/fetch_gcp.py) applies over them.
+"""
+import json
+
+import pytest
+
+from skypilot_tpu import catalog
+from skypilot_tpu.catalog import billing, fetcher
+from skypilot_tpu.provision.gcp import client
+
+
+# --------------------------------------------------------------------- #
+# Spot-checks against published list prices (USD, 2025-07 snapshot)
+# --------------------------------------------------------------------- #
+
+def _offering(tpu_type, zone):
+    offs = catalog.get_tpu_offerings(tpu_type, zone=zone)
+    assert offs, f'no offering for {tpu_type} in {zone}'
+    return offs[0]
+
+
+def test_published_us_anchor_prices():
+    """The US anchors are the numbers on the public pricing page:
+    v2-8 $4.50/hr; v4 $3.22, v5e $1.20, v5p $4.20, v6e $2.70 per
+    chip-hour."""
+    assert _offering('v2-8', 'us-central1-b').price_hr == 4.50
+    # v4-8 = 4 chips (2 TensorCores/chip).
+    assert _offering('v4-8', 'us-central2-b').price_hr == \
+        pytest.approx(4 * 3.22)
+    assert _offering('v5e-1', 'us-central1-a').price_hr == 1.20
+    assert _offering('v5p-8', 'us-east5-a').price_hr == \
+        pytest.approx(4 * 4.20)
+    assert _offering('v6e-8', 'us-east1-d').price_hr == \
+        pytest.approx(8 * 2.70)
+
+
+def test_spot_discounts_sane():
+    """Spot prices follow GCP's published TPU discounts (~70% off for
+    v2-v4, ~55% off for v5e/v5p/v6e) — never free, never >= on-demand."""
+    for tpu_type, zone in [('v2-8', 'us-central1-b'),
+                           ('v4-8', 'us-central2-b'),
+                           ('v5e-8', 'us-west1-c'),
+                           ('v5p-8', 'us-east5-a'),
+                           ('v6e-8', 'us-east5-a')]:
+        off = _offering(tpu_type, zone)
+        ratio = off.spot_price_hr / off.price_hr
+        assert 0.25 <= ratio <= 0.5, (tpu_type, ratio)
+
+
+def test_regional_prices_pinned_not_derived():
+    """europe-west4 v5e carries its own published price ($1.32/chip),
+    not a continent multiplier."""
+    eu = _offering('v5e-8', 'europe-west4-b')
+    assert eu.price_hr == pytest.approx(8 * 1.32)
+
+
+def test_price_scales_with_chips():
+    small = _offering('v5p-8', 'us-east5-a')
+    big = _offering('v5p-64', 'us-east5-a')
+    assert big.price_hr == pytest.approx(small.price_hr * 8)
+
+
+# --------------------------------------------------------------------- #
+# Billing-API overlay
+# --------------------------------------------------------------------- #
+
+class FakeBillingService:
+    """Two-page services list + paged SKU list, exercising pagination
+    and description parsing."""
+
+    def __call__(self, method, url, headers, body, timeout):
+        if '/services?' in url and 'pageToken' not in url:
+            return 200, json.dumps({
+                'services': [{'name': 'services/AAAA-11',
+                              'displayName': 'Compute Engine'}],
+                'nextPageToken': 'p2'}).encode()
+        if '/services?' in url:
+            return 200, json.dumps({
+                'services': [{'name': 'services/BBBB-22',
+                              'displayName': 'Cloud TPU'}]}).encode()
+        if '/services/BBBB-22/skus' in url and 'pageToken' not in url:
+            return 200, json.dumps({
+                'skus': [
+                    {'description': 'Tpu-v5p chip-hour',
+                     'serviceRegions': ['us-east5'],
+                     'category': {'usageType': 'OnDemand'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'h',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '4', 'nanos': 500000000}}]}}]},
+                    {'description': 'Preemptible Tpu-v5p chip-hour',
+                     'serviceRegions': ['us-east5'],
+                     'category': {'usageType': 'Preemptible'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'h',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '2', 'nanos': 0}}]}}]},
+                    # Must be IGNORED: commitment (CUD) rate, not usage.
+                    {'description': 'Commitment v1: Tpu-v5p for 1 year',
+                     'serviceRegions': ['us-east5'],
+                     'category': {'usageType': 'Commit1Yr'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'h',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '1', 'nanos': 0}}]}}]},
+                    # Must be IGNORED: not an hourly usage unit.
+                    {'description': 'Tpu-v5p pod slice month',
+                     'serviceRegions': ['us-east5'],
+                     'category': {'usageType': 'OnDemand'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'mo',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '999', 'nanos': 0}}]}}]},
+                ],
+                'nextPageToken': 's2'}).encode()
+        if '/services/BBBB-22/skus' in url:
+            return 200, json.dumps({
+                'skus': [
+                    {'description': 'TPU v5 Lite chip-hour',
+                     'serviceRegions': ['europe-west4'],
+                     'category': {'usageType': 'OnDemand'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'h',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '1', 'nanos': 400000000}}]}}]},
+                    {'description': 'N2 Instance Core (not a TPU)',
+                     'serviceRegions': ['us-east5'],
+                     'category': {'usageType': 'OnDemand'},
+                     'pricingInfo': [{'pricingExpression': {
+                         'usageUnit': 'h',
+                         'tieredRates': [{'unitPrice': {
+                             'units': '0', 'nanos': 1}}]}}]},
+                ]}).encode()
+        return 404, b'{}'
+
+
+@pytest.fixture
+def fake_billing(tmp_path, monkeypatch):
+    client.set_transport(FakeBillingService())
+    client.set_token_provider(lambda: 'fake-token')
+    monkeypatch.setattr(fetcher, 'PRICE_OVERLAY_PATH',
+                        tmp_path / 'price_overlay.json')
+    yield
+    client.set_transport(None)
+    client.set_token_provider(None)
+
+
+def test_refresh_overlay_applies_live_prices(fake_billing, tmp_path):
+    overlay = billing.refresh_price_overlay()
+    assert overlay['v5p']['us-east5'] == (4.5, 2.0)
+    # v5e spot SKU absent -> 0.0 marker, falls back to pinned per-cell.
+    assert overlay['v5e']['europe-west4'] == (1.4, 0.0)
+
+    od, spot = fetcher.chip_prices('v5p', 'us-east5')
+    assert (od, spot) == (4.5, 2.0)
+    od, spot = fetcher.chip_prices('v5e', 'europe-west4')
+    assert od == 1.4
+    assert spot == fetcher.TPU_REGION_PRICES['v5e']['europe-west4'][1]
+    # Untouched cells keep pinned values.
+    assert fetcher.chip_prices('v6e', 'us-east1') == \
+        fetcher.TPU_REGION_PRICES['v6e']['us-east1']
+
+    # The generated CSV reflects the overlay.
+    csv_path = tmp_path / 'tpu.csv'
+    fetcher.generate_tpu_csv(csv_path)
+    import csv as csv_lib
+    with open(csv_path) as f:
+        rows = [r for r in csv_lib.DictReader(f)
+                if r['tpu_type'] == 'v5p-8' and r['region'] == 'us-east5']
+    assert rows and float(rows[0]['price_hr']) == pytest.approx(4 * 4.5)
+
+
+def test_refresh_without_credentials_raises(monkeypatch):
+    from skypilot_tpu import exceptions
+    client.set_transport(None)
+    client.set_token_provider(None)
+    monkeypatch.delenv('GOOGLE_OAUTH_ACCESS_TOKEN', raising=False)
+    monkeypatch.setattr(client.shutil, 'which', lambda _: None)
+    monkeypatch.setattr(client, '_maybe_on_gce', lambda: False)
+    monkeypatch.setattr(client, '_cached_token', None)
+    with pytest.raises(exceptions.NoCloudAccessError):
+        billing.refresh_price_overlay()
