@@ -176,14 +176,17 @@ def check_autostop() -> None:
         return
     from skypilot_tpu import provision
     cluster_name = info['cluster_name']
+    provider_config = info.get('provider_config') or {}
     if cfg.get('down', False) or info.get('is_pod', False):
-        provision.terminate_instances(info['provider_name'], cluster_name)
+        provision.terminate_instances(info['provider_name'], cluster_name,
+                                      provider_config)
     else:
         try:
-            provision.stop_instances(info['provider_name'], cluster_name)
+            provision.stop_instances(info['provider_name'], cluster_name,
+                                     provider_config)
         except Exception:  # noqa: BLE001 — pods can't stop; fall back
             provision.terminate_instances(info['provider_name'],
-                                          cluster_name)
+                                          cluster_name, provider_config)
 
 
 # --------------------------------------------------------------------- #

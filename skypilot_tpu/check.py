@@ -38,7 +38,21 @@ def _check_fake() -> Tuple[bool, Optional[str]]:
     return False, 'Set SKYT_ENABLE_FAKE_CLOUD=1 to enable.'
 
 
-_CHECKS = {'gcp': _check_gcp, 'fake': _check_fake}
+def _check_gke() -> Tuple[bool, Optional[str]]:
+    """GKE is enabled iff an API server is configured AND Google
+    credentials resolve (GKE accepts the same OAuth bearer token)."""
+    import os
+    import shutil
+    if not os.environ.get('SKYT_GKE_API_SERVER'):
+        return False, ('Set SKYT_GKE_API_SERVER to the cluster control '
+                       'plane URL to enable.')
+    if not shutil.which('kubectl'):
+        return False, 'kubectl not found on PATH.'
+    ok, reason = _check_gcp()
+    return (True, None) if ok else (False, reason)
+
+
+_CHECKS = {'gcp': _check_gcp, 'gke': _check_gke, 'fake': _check_fake}
 
 
 def check(quiet: bool = False) -> List[str]:

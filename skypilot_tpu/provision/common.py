@@ -67,6 +67,11 @@ class ClusterInfo:
     zone: str
     instances: List[InstanceInfo]
     ssh_user: str = ''
+    # Provider bookkeeping (api endpoints, project ids, namespaces) the
+    # ON-CLUSTER daemon needs to call the provider from the inside
+    # (autostop stop/terminate) — serialized into cluster_info.json.
+    provider_config: Dict[str, Any] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def head_instance(self) -> InstanceInfo:
@@ -91,6 +96,7 @@ class ClusterInfo:
             'region': self.region,
             'zone': self.zone,
             'ssh_user': self.ssh_user,
+            'provider_config': self.provider_config,
             'instances': [dataclasses.asdict(i) for i in self.instances],
         }
 
@@ -100,7 +106,8 @@ class ClusterInfo:
         return cls(provider_name=d['provider_name'],
                    cluster_name=d['cluster_name'], region=d['region'],
                    zone=d['zone'], instances=insts,
-                   ssh_user=d.get('ssh_user', ''))
+                   ssh_user=d.get('ssh_user', ''),
+                   provider_config=d.get('provider_config', {}))
 
 
 class InstanceStatus:

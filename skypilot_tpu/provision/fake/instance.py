@@ -166,9 +166,10 @@ def _instances(meta: Dict[str, Any]) -> List[common.InstanceInfo]:
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict] = None) -> None:
     """Directories are instantly 'booted'."""
-    del region, cluster_name, state
+    del region, cluster_name, state, provider_config
 
 
 def stop_instances(cluster_name: str,

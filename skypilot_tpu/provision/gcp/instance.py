@@ -177,7 +177,9 @@ def _get_gce_or_none(project: str, zone: str,
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None
+                   ) -> None:
     """run_instances already waits on the create/start LROs; TPU READY and
     GCE RUNNING are reached before it returns."""
     del region, cluster_name, state

@@ -1,0 +1,351 @@
+"""GKE TPU pod-slice provider (the 11-function protocol of
+provision/__init__.py against the Kubernetes API).
+
+Reference: sky/provision/kubernetes/instance.py (+utils.py TPU label
+formatters; smoke test tests/smoke_tests/test_cluster_job.py:578
+`--gpus tpu-v5-lite-podslice`). The reference models one pod per
+requested node and schedules TPUs via the `google.com/tpu` resource +
+GKE's podslice node selectors; we keep that contract but emit it from
+the typed TpuTopology instead of pseudo-accelerator names:
+
+  * nodeSelector cloud.google.com/gke-tpu-accelerator: <podslice label>
+  * nodeSelector cloud.google.com/gke-tpu-topology: <AxB | AxBxC>
+  * resources google.com/tpu: <chips per host>
+
+One framework "node" = one TPU slice; a multi-host slice fans out to
+`num_hosts` pods (one per TPU host VM), named
+`<cluster>-n<node>-h<host>`, plus one headless Service for stable DNS.
+Pods cannot stop, so stop_instances raises and autostop means autodown
+— the same semantics as TPU pod slices on plain GCP.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gke import k8s_client
+
+logger = sky_logging.init_logger(__name__)
+
+PROVIDER_NAME = 'gke'
+
+# TPU generation -> GKE podslice accelerator label
+# (reference: kubernetes/utils.py label formatters; GKE docs).
+GKE_TPU_ACCELERATORS = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+
+# chips -> gke-tpu-topology. v5e/v6e use 2D (4 chips/host grid);
+# v4/v5p use 3D (4-chip hosts in a cube).
+_TOPOLOGY_2D = {1: '1x1', 4: '2x2', 8: '2x4', 16: '4x4', 32: '4x8',
+                64: '8x8', 128: '8x16', 256: '16x16'}
+_TOPOLOGY_3D = {4: '2x2x1', 8: '2x2x2', 16: '2x2x4', 32: '2x4x4',
+                64: '4x4x4', 128: '4x4x8', 256: '4x8x8', 512: '8x8x8',
+                1024: '8x8x16', 2048: '8x16x16'}
+
+
+def gke_topology_label(topo) -> str:
+    table = (_TOPOLOGY_2D if topo.generation in ('v5e', 'v6e')
+             else _TOPOLOGY_3D)
+    label = table.get(topo.num_chips)
+    if label is None:
+        raise exceptions.InvalidResourcesError(
+            f'{topo.type_name}: no GKE topology mapping for '
+            f'{topo.num_chips} chips.')
+    return label
+
+
+def _cfg(provider_config: Optional[Dict]) -> Dict[str, Any]:
+    import os
+    cfg = dict(provider_config or {})
+    cfg.setdefault('api_server', os.environ.get('SKYT_GKE_API_SERVER'))
+    cfg.setdefault('namespace', 'default')
+    cfg.setdefault('image', 'python:3.11-slim')
+    if not cfg['api_server']:
+        raise exceptions.NoCloudAccessError(
+            'GKE provider needs an API server: set SKYT_GKE_API_SERVER '
+            'or provider_config.api_server.')
+    return cfg
+
+
+def _pods_path(ns: str, name: str = '') -> str:
+    return f'/api/v1/namespaces/{ns}/pods' + (f'/{name}' if name else '')
+
+
+def _svc_path(ns: str, name: str = '') -> str:
+    return (f'/api/v1/namespaces/{ns}/services'
+            + (f'/{name}' if name else ''))
+
+
+def _selector(cluster_name: str) -> str:
+    return f'?labelSelector=skyt-cluster%3D{cluster_name}'
+
+
+def _list_pods(cfg: Dict[str, Any], cluster_name: str) -> List[Dict]:
+    resp = k8s_client.request(
+        cfg['api_server'], 'GET',
+        _pods_path(cfg['namespace']) + _selector(cluster_name))
+    return resp.get('items', [])
+
+
+def bootstrap_config(config: common.ProvisionConfig
+                     ) -> common.ProvisionConfig:
+    """Validate the TPU request maps to GKE labels; fill defaults."""
+    config.provider_config.update(_cfg(config.provider_config))
+    res = config.resources
+    if res.tpu is not None:
+        if res.tpu.generation not in GKE_TPU_ACCELERATORS:
+            raise exceptions.InvalidResourcesError(
+                f'GKE has no podslice node pools for TPU '
+                f'{res.tpu.generation}.')
+        gke_topology_label(res.tpu)  # raises if unmapped
+    return config
+
+
+def _pod_body(config: common.ProvisionConfig, pod_name: str,
+              node_index: int, host_index: int) -> Dict[str, Any]:
+    res = config.resources
+    cfg = config.provider_config
+    labels = {'skyt-cluster': config.cluster_name,
+              'skyt-node': str(node_index),
+              'skyt-host': str(host_index), **config.labels}
+    spec: Dict[str, Any] = {
+        'hostname': pod_name,
+        'subdomain': config.cluster_name,
+        'restartPolicy': 'Never',
+        'containers': [{
+            'name': 'skyt',
+            'image': cfg['image'],
+            'command': ['/bin/sh', '-c', 'sleep infinity'],
+        }],
+    }
+    if res.tpu is not None:
+        topo = res.tpu
+        spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator':
+                GKE_TPU_ACCELERATORS[topo.generation],
+            'cloud.google.com/gke-tpu-topology': gke_topology_label(topo),
+        }
+        tpu_res = {'google.com/tpu': str(topo.chips_per_host)}
+        spec['containers'][0]['resources'] = {'requests': tpu_res,
+                                              'limits': tpu_res}
+    return {'apiVersion': 'v1', 'kind': 'Pod',
+            'metadata': {'name': pod_name, 'labels': labels},
+            'spec': spec}
+
+
+def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
+    cfg = config.provider_config
+    ns = cfg['namespace']
+    api = cfg['api_server']
+    res = config.resources
+    hosts_per_node = res.num_hosts()
+    existing = {p['metadata']['name'] for p in
+                _list_pods(cfg, config.cluster_name)}
+    created: List[str] = []
+    # Headless service: stable DNS for host-to-host rendezvous
+    # (<pod>.<cluster>.<ns>.svc), same role as TPU-VM internal IPs.
+    try:
+        k8s_client.request(api, 'POST', _svc_path(ns), {
+            'apiVersion': 'v1', 'kind': 'Service',
+            'metadata': {'name': config.cluster_name,
+                         'labels': {'skyt-cluster': config.cluster_name}},
+            'spec': {'clusterIP': 'None',
+                     'selector': {'skyt-cluster': config.cluster_name}},
+        })
+    except k8s_client.K8sApiError as e:
+        if e.status != 409:  # already exists on reuse
+            raise _classify(e, config.zone)
+    for node in range(config.num_nodes):
+        for host in range(hosts_per_node):
+            pod_name = f'{config.cluster_name}-n{node}-h{host}'
+            if pod_name in existing:
+                continue
+            try:
+                k8s_client.request(
+                    api, 'POST', _pods_path(ns),
+                    _pod_body(config, pod_name, node, host))
+            except k8s_client.K8sApiError as e:
+                raise _classify(e, config.zone)
+            created.append(pod_name)
+    return common.ProvisionRecord(
+        provider_name=PROVIDER_NAME, cluster_name=config.cluster_name,
+        region=config.region, zone=config.zone,
+        resumed_instance_ids=[], created_instance_ids=created)
+
+
+def _classify(e: k8s_client.K8sApiError, zone: str):
+    """K8s failures -> typed failover errors (parallels
+    gcp/client.classify_api_error): unschedulable TPU pods are capacity,
+    quota'd namespaces are quota, auth is cloud-fatal."""
+    msg = e.message.lower()
+    if 'exceeded quota' in msg or e.reason == 'Forbidden' and 'quota' in msg:
+        return exceptions.QuotaExceededError(e.message)
+    if e.status in (401, 403):
+        return exceptions.ProvisionError(
+            e.message, scope=exceptions.FailoverScope.CLOUD,
+            retryable=False)
+    if 'insufficient' in msg or 'unschedulable' in msg:
+        return exceptions.TpuCapacityError(e.message)
+    return exceptions.ProvisionError(f'{e.message} (zone {zone})')
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: str = 'running',
+                   provider_config: Optional[Dict] = None,
+                   timeout: float = 600.0) -> None:
+    """Block until every pod is Running (or gone, for state='terminated').
+    An unschedulable pod (no TPU node pool capacity) surfaces as a
+    TpuCapacityError so the failover engine can move on."""
+    cfg = _cfg(provider_config)
+    deadline = time.time() + timeout
+    while True:
+        pods = _list_pods(cfg, cluster_name)
+        if state == 'terminated':
+            if not pods:
+                return
+        else:
+            phases = [p.get('status', {}).get('phase') for p in pods]
+            if pods and all(ph == 'Running' for ph in phases):
+                return
+            for pod, phase in zip(pods, phases):
+                # Fast-fail: Failed/Succeeded can never become Running
+                # (restartPolicy=Never) — burning the full timeout would
+                # delay failover to the next zone by minutes.
+                if phase in ('Failed', 'Succeeded'):
+                    raise exceptions.ProvisionError(
+                        f'GKE pod {pod["metadata"]["name"]} entered '
+                        f'terminal phase {phase} during provisioning.')
+                for cond in pod.get('status', {}).get('conditions', []):
+                    if (cond.get('reason') == 'Unschedulable'
+                            and 'tpu' in str(cond.get('message', '')
+                                             ).lower()):
+                        raise exceptions.TpuCapacityError(
+                            f'GKE cannot schedule '
+                            f'{pod["metadata"]["name"]}: '
+                            f'{cond.get("message")}')
+        if time.time() > deadline:
+            raise exceptions.ProvisionError(
+                f'GKE pods for {cluster_name!r} not {state} after '
+                f'{timeout}s')
+        time.sleep(2)
+
+
+def stop_instances(cluster_name: str,
+                   provider_config: Optional[Dict] = None) -> None:
+    raise exceptions.NotSupportedError(
+        'GKE TPU pod slices cannot stop (no VM disks to preserve); '
+        'use down instead.')
+
+
+def terminate_instances(cluster_name: str,
+                        provider_config: Optional[Dict] = None) -> None:
+    cfg = _cfg(provider_config)
+    api, ns = cfg['api_server'], cfg['namespace']
+    for pod in _list_pods(cfg, cluster_name):
+        try:
+            k8s_client.request(api, 'DELETE',
+                               _pods_path(ns, pod['metadata']['name']))
+        except k8s_client.K8sApiError as e:
+            if e.status != 404:
+                raise
+    for path in (_svc_path(ns, cluster_name),
+                 _svc_path(ns, f'{cluster_name}-ports')):
+        try:
+            k8s_client.request(api, 'DELETE', path)
+        except k8s_client.K8sApiError as e:
+            if e.status != 404:
+                raise
+
+
+def query_instances(cluster_name: str,
+                    provider_config: Optional[Dict] = None
+                    ) -> Dict[str, str]:
+    cfg = _cfg(provider_config)
+    out: Dict[str, str] = {}
+    for pod in _list_pods(cfg, cluster_name):
+        phase = pod.get('status', {}).get('phase', 'Pending')
+        status = {'Pending': common.InstanceStatus.PENDING,
+                  'Running': common.InstanceStatus.RUNNING,
+                  'Succeeded': common.InstanceStatus.TERMINATED,
+                  'Failed': common.InstanceStatus.TERMINATED,
+                  }.get(phase, common.InstanceStatus.PENDING)
+        out[pod['metadata']['name']] = status
+    return out
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict] = None
+                     ) -> common.ClusterInfo:
+    cfg = _cfg(provider_config)
+    instances = []
+    for pod in _list_pods(cfg, cluster_name):
+        meta = pod['metadata']
+        labels = meta.get('labels', {})
+        instances.append(common.InstanceInfo(
+            instance_id=meta['name'],
+            internal_ip=pod.get('status', {}).get('podIP', ''),
+            external_ip=None,
+            node_index=int(labels.get('skyt-node', 0)),
+            host_index=int(labels.get('skyt-host', 0)),
+            tags=dict(labels),
+            runner_spec={'kind': 'kubectl',
+                         'namespace': cfg['namespace'],
+                         'pod': meta['name'],
+                         'container': 'skyt',
+                         'context': cfg.get('context')}))
+    if not instances:
+        raise exceptions.ClusterDoesNotExist(cluster_name)
+    return common.ClusterInfo(
+        provider_name=PROVIDER_NAME, cluster_name=cluster_name,
+        region=region, zone=region, instances=instances, ssh_user='root')
+
+
+def open_ports(cluster_name: str, ports: List[int],
+               provider_config: Optional[Dict] = None) -> None:
+    """Expose ports via a LoadBalancer Service selecting the cluster's
+    pods (the k8s-native analog of the GCE firewall rule)."""
+    cfg = _cfg(provider_config)
+    api, ns = cfg['api_server'], cfg['namespace']
+    name = f'{cluster_name}-ports'
+    body = {
+        'apiVersion': 'v1', 'kind': 'Service',
+        'metadata': {'name': name,
+                     'labels': {'skyt-cluster': cluster_name}},
+        'spec': {'type': 'LoadBalancer',
+                 'selector': {'skyt-cluster': cluster_name},
+                 'ports': [{'name': f'p{p}', 'port': int(p),
+                            'targetPort': int(p)} for p in ports]},
+    }
+    try:
+        k8s_client.request(api, 'POST', _svc_path(ns), body)
+    except k8s_client.K8sApiError as e:
+        if e.status != 409:
+            raise
+        # Replace must carry the live object's immutable fields
+        # (spec.clusterIP and metadata.resourceVersion) or the API
+        # server rejects the PUT with 422.
+        live = k8s_client.request(api, 'GET', _svc_path(ns, name))
+        live.setdefault('spec', {})['ports'] = body['spec']['ports']
+        live['spec']['type'] = 'LoadBalancer'
+        live['spec']['selector'] = body['spec']['selector']
+        k8s_client.request(api, 'PUT', _svc_path(ns, name), live)
+
+
+def cleanup_ports(cluster_name: str, ports: List[int],
+                  provider_config: Optional[Dict] = None) -> None:
+    del ports
+    cfg = _cfg(provider_config)
+    try:
+        k8s_client.request(cfg['api_server'], 'DELETE',
+                           _svc_path(cfg['namespace'],
+                                     f'{cluster_name}-ports'))
+    except k8s_client.K8sApiError as e:
+        if e.status != 404:
+            raise

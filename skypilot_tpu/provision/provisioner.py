@@ -79,9 +79,13 @@ def provision_with_failover(
             config = provision.bootstrap_config(cloud, config)
             record = provision.run_instances(cloud, config)
             provision.wait_instances(cloud, region, cluster_name,
-                                     common.InstanceStatus.RUNNING)
+                                     common.InstanceStatus.RUNNING,
+                                     config.provider_config)
             info = provision.get_cluster_info(cloud, region, cluster_name,
                                               config.provider_config)
+            # Ship the provider bookkeeping to the head (cluster_info
+            # .json) so the daemon can autostop/terminate from inside.
+            info.provider_config = config.provider_config
             concrete = resources.copy(cloud=cloud, region=region, zone=zone)
             return ProvisionResult(record=record, cluster_info=info,
                                    resources=concrete,

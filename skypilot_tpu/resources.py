@@ -18,7 +18,7 @@ from skypilot_tpu import tpu_topology
 
 _DEFAULT_DISK_SIZE_GB = 100
 
-SUPPORTED_CLOUDS = ('gcp', 'fake')
+SUPPORTED_CLOUDS = ('gcp', 'gke', 'fake')
 
 
 @dataclasses.dataclass(frozen=True)

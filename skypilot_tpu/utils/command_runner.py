@@ -228,6 +228,61 @@ class SSHCommandRunner(CommandRunner):
         return proc.returncode
 
 
+class KubectlCommandRunner(CommandRunner):
+    """Reach a pod via kubectl exec / kubectl cp (reference:
+    KubernetesCommandRunner, command_runner.py:685 — also kubectl-based).
+    Used by the GKE TPU pod-slice provider."""
+
+    def __init__(self, namespace: str, pod: str,
+                 container: Optional[str] = None,
+                 context: Optional[str] = None) -> None:
+        self.namespace = namespace
+        self.pod = pod
+        self.container = container
+        self.context = context
+
+    def _base(self) -> List[str]:
+        args = ['kubectl', '-n', self.namespace]
+        if self.context:
+            args += ['--context', self.context]
+        return args
+
+    def run(self, cmd: str, *, env: Optional[Dict[str, str]] = None,
+            stream_logs: bool = False, log_path: Optional[str] = None,
+            require_outputs: bool = False, check: bool = False,
+            timeout: Optional[float] = None):
+        exec_args = self._base() + ['exec', self.pod]
+        if self.container:
+            exec_args += ['-c', self.container]
+        remote = f'bash -c {shlex.quote(_env_prefix(env) + cmd)}'
+        return self._finish(
+            exec_args + ['--'], env_cmd='', cmd=remote,
+            stream_logs=stream_logs, log_path=log_path,
+            require_outputs=require_outputs, check=check, timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              check: bool = True) -> int:
+        # kubectl cp cannot expand '~'; pod $HOME is /root for our images.
+        def _expand(path: str) -> str:
+            return '/root' + path[1:] if path.startswith('~') else path
+        pod_ref = f'{self.namespace}/{self.pod}'
+        if up:
+            src = os.path.expanduser(source.rstrip('/'))
+            dst = f'{pod_ref}:{_expand(target)}'
+        else:
+            src = f'{pod_ref}:{_expand(source)}'
+            dst = os.path.expanduser(target)
+        args = self._base() + ['cp', src, dst]
+        if self.container:
+            args += ['-c', self.container]
+        proc = subprocess.run(args, capture_output=True, check=False)
+        if check and proc.returncode != 0:
+            raise exceptions.CommandError(
+                proc.returncode, ' '.join(args),
+                proc.stderr.decode(errors='replace'))
+        return proc.returncode
+
+
 def runner_from_spec(spec: Dict) -> CommandRunner:
     """Rebuild a runner from its serialized form (stored in
     cluster_info.json on the head so the on-head executor can reach
@@ -240,4 +295,8 @@ def runner_from_spec(spec: Dict) -> CommandRunner:
                                 spec['ssh_key_path'],
                                 port=spec.get('port', 22),
                                 proxy_command=spec.get('proxy_command'))
+    if kind == 'kubectl':
+        return KubectlCommandRunner(spec['namespace'], spec['pod'],
+                                    container=spec.get('container'),
+                                    context=spec.get('context'))
     raise ValueError(f'Unknown runner kind {kind!r}')

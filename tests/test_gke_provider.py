@@ -1,0 +1,280 @@
+"""GKE TPU pod-slice provider against an in-memory fake of the
+Kubernetes API (round-2 verdict #8; reference:
+sky/provision/kubernetes/instance.py + utils.py TPU label formatters,
+smoke test tests/smoke_tests/test_cluster_job.py:578). Parity with
+tests/test_gcp_provider.py: full protocol lifecycle, multi-host fan-out,
+TPU podslice labels, capacity classification, port services.
+"""
+import json
+import re
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import tpu_topology
+from skypilot_tpu.provision import common
+from skypilot_tpu.provision.gke import instance as gke_instance
+from skypilot_tpu.provision.gke import k8s_client
+
+
+class FakeK8sApi:
+    """In-memory namespaces/{pods,services} REST surface."""
+
+    def __init__(self, unschedulable=False, quota_fail=False):
+        self.pods = {}        # name -> pod dict
+        self.services = {}    # name -> service dict
+        self.unschedulable = unschedulable
+        self.quota_fail = quota_fail
+        self.requests = []
+
+    def __call__(self, method, url, headers, body, timeout):
+        self.requests.append((method, url))
+        data = json.loads(body) if body else {}
+        status, resp = self.route(method, url, data)
+        return status, json.dumps(resp).encode()
+
+    def _err(self, status, reason, message):
+        return status, {'reason': reason, 'message': message}
+
+    def route(self, method, url, data):
+        m = re.match(
+            r'https://k8s\.test/api/v1/namespaces/(?P<ns>[^/]+)/'
+            r'(?P<kind>pods|services)(/(?P<name>[^?/]+))?'
+            r'(\?labelSelector=skyt-cluster%3D(?P<sel>.+))?$', url)
+        if not m:
+            return self._err(404, 'NotFound', url)
+        store = self.pods if m['kind'] == 'pods' else self.services
+        if method == 'POST':
+            name = data['metadata']['name']
+            if self.quota_fail and m['kind'] == 'pods':
+                return self._err(
+                    403, 'Forbidden',
+                    'pods "x" is forbidden: exceeded quota: tpu-quota')
+            if name in store:
+                return self._err(409, 'AlreadyExists', name)
+            if m['kind'] == 'services' and \
+                    data.get('spec', {}).get('clusterIP') != 'None':
+                # API server assigns a ClusterIP; it is then immutable.
+                data.setdefault('spec', {})['clusterIP'] = \
+                    f'34.118.0.{len(self.services) + 2}'
+            if m['kind'] == 'pods':
+                if self.unschedulable:
+                    data['status'] = {
+                        'phase': 'Pending',
+                        'conditions': [{
+                            'type': 'PodScheduled', 'status': 'False',
+                            'reason': 'Unschedulable',
+                            'message': '0/3 nodes: insufficient '
+                                       'google.com/tpu'}]}
+                else:
+                    data['status'] = {
+                        'phase': 'Running',
+                        'podIP': f'10.8.0.{len(self.pods) + 2}'}
+            store[name] = data
+            return 200, data
+        if method == 'GET' and m['name'] is None:
+            items = list(store.values())
+            if m['sel']:
+                items = [i for i in items
+                         if i['metadata'].get('labels', {})
+                         .get('skyt-cluster') == m['sel']]
+            return 200, {'items': items}
+        if m['name'] is not None:
+            if method == 'GET':
+                if m['name'] not in store:
+                    return self._err(404, 'NotFound', m['name'])
+                return 200, store[m['name']]
+            if method == 'DELETE':
+                if m['name'] not in store:
+                    return self._err(404, 'NotFound', m['name'])
+                del store[m['name']]
+                return 200, {'status': 'Success'}
+            if method == 'PUT':
+                old_ip = store.get(m['name'], {}).get('spec', {}) \
+                    .get('clusterIP')
+                new_ip = data.get('spec', {}).get('clusterIP')
+                if old_ip and new_ip != old_ip:
+                    return self._err(
+                        422, 'Invalid',
+                        'spec.clusterIP: Invalid value: field is '
+                        'immutable')
+                store[m['name']] = data
+                return 200, data
+        return self._err(405, 'MethodNotAllowed', method)
+
+
+@pytest.fixture
+def fake_k8s():
+    def install(**kwargs):
+        svc = FakeK8sApi(**kwargs)
+        k8s_client.set_transport(svc)
+        from skypilot_tpu.provision.gcp import client as gcp_client
+        gcp_client.set_token_provider(lambda: 'fake-token')
+        return svc
+    yield install
+    k8s_client.set_transport(None)
+    from skypilot_tpu.provision.gcp import client as gcp_client
+    gcp_client.set_token_provider(None)
+
+
+def _config(tpu='v5e-8', num_nodes=1, cluster='kcluster', **res_kw):
+    res = resources_lib.Resources(
+        cloud='gke', tpu=tpu_topology.parse_tpu_type(tpu), **res_kw)
+    cfg = common.ProvisionConfig(
+        cluster_name=cluster, cloud='gke', region='us-gke',
+        zone='us-gke', num_nodes=num_nodes, resources=res,
+        authentication={},
+        provider_config={'api_server': 'https://k8s.test'})
+    return gke_instance.bootstrap_config(cfg)
+
+
+def test_podslice_labels_and_lifecycle(fake_k8s):
+    """v5e-16 = 2 hosts x 8 chips: two pods with the podslice selector,
+    topology 4x4, google.com/tpu=8 each, plus a headless service."""
+    svc = fake_k8s()
+    cfg = _config('v5e-16')
+    record = gke_instance.run_instances(cfg)
+    assert sorted(record.created_instance_ids) == \
+        ['kcluster-n0-h0', 'kcluster-n0-h1']
+    pod = svc.pods['kcluster-n0-h0']
+    sel = pod['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == \
+        'tpu-v5-lite-podslice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '4x4'
+    req = pod['spec']['containers'][0]['resources']['requests']
+    assert req['google.com/tpu'] == '8'
+    assert svc.services['kcluster']['spec']['clusterIP'] == 'None'
+
+    gke_instance.wait_instances('us-gke', 'kcluster',
+                                provider_config=cfg.provider_config)
+    statuses = gke_instance.query_instances(
+        'kcluster', provider_config=cfg.provider_config)
+    assert set(statuses.values()) == {common.InstanceStatus.RUNNING}
+
+    info = gke_instance.get_cluster_info(
+        'us-gke', 'kcluster', provider_config=cfg.provider_config)
+    assert info.num_hosts == 2
+    hosts = info.sorted_instances()
+    assert [h.host_index for h in hosts] == [0, 1]
+    assert hosts[0].internal_ip.startswith('10.8.')
+    assert hosts[0].runner_spec['kind'] == 'kubectl'
+
+    gke_instance.terminate_instances(
+        'kcluster', provider_config=cfg.provider_config)
+    assert not svc.pods and 'kcluster' not in svc.services
+
+
+def test_v5p_3d_topology(fake_k8s):
+    """v5p-64 = 32 chips / 8 hosts: 3D topology 2x4x4, v5p-slice label."""
+    svc = fake_k8s()
+    record = gke_instance.run_instances(_config('v5p-64'))
+    assert len(record.created_instance_ids) == 8
+    sel = svc.pods['kcluster-n0-h0']['spec']['nodeSelector']
+    assert sel['cloud.google.com/gke-tpu-accelerator'] == 'tpu-v5p-slice'
+    assert sel['cloud.google.com/gke-tpu-topology'] == '2x4x4'
+
+
+def test_unschedulable_is_capacity_error(fake_k8s):
+    """No TPU node-pool capacity -> TpuCapacityError so failover can
+    move to the next candidate (parity with GCP stockout mapping)."""
+    fake_k8s(unschedulable=True)
+    cfg = _config('v5e-8')
+    gke_instance.run_instances(cfg)
+    with pytest.raises(exceptions.TpuCapacityError):
+        gke_instance.wait_instances('us-gke', 'kcluster',
+                                    provider_config=cfg.provider_config,
+                                    timeout=5)
+
+
+def test_quota_is_quota_error(fake_k8s):
+    fake_k8s(quota_fail=True)
+    with pytest.raises(exceptions.QuotaExceededError):
+        gke_instance.run_instances(_config('v5e-8'))
+
+
+def test_pods_cannot_stop(fake_k8s):
+    fake_k8s()
+    with pytest.raises(exceptions.NotSupportedError):
+        gke_instance.stop_instances('kcluster',
+                                    provider_config={
+                                        'api_server': 'https://k8s.test'})
+
+
+def test_port_service_lifecycle(fake_k8s):
+    """open_ports creates a LoadBalancer service; re-open replaces the
+    port set; cleanup + terminate remove it."""
+    svc = fake_k8s()
+    cfg = _config('v5e-8')
+    gke_instance.run_instances(cfg)
+    gke_instance.open_ports('kcluster', [8000],
+                            provider_config=cfg.provider_config)
+    ports_svc = svc.services['kcluster-ports']
+    assert ports_svc['spec']['type'] == 'LoadBalancer'
+    assert [p['port'] for p in ports_svc['spec']['ports']] == [8000]
+    gke_instance.open_ports('kcluster', [8000, 9000],
+                            provider_config=cfg.provider_config)
+    assert [p['port'] for p in
+            svc.services['kcluster-ports']['spec']['ports']] == \
+        [8000, 9000]
+    gke_instance.terminate_instances(
+        'kcluster', provider_config=cfg.provider_config)
+    assert 'kcluster-ports' not in svc.services
+
+
+def test_wait_fast_fails_on_terminal_pod(fake_k8s):
+    """A Failed pod (restartPolicy=Never) can never become Running —
+    wait must raise immediately, not burn the timeout."""
+    svc = fake_k8s()
+    cfg = _config('v5e-16')
+    gke_instance.run_instances(cfg)
+    svc.pods['kcluster-n0-h1']['status']['phase'] = 'Failed'
+    import time
+    t0 = time.time()
+    with pytest.raises(exceptions.ProvisionError):
+        gke_instance.wait_instances('us-gke', 'kcluster',
+                                    provider_config=cfg.provider_config,
+                                    timeout=60)
+    assert time.time() - t0 < 10
+
+
+def test_cluster_info_carries_provider_config():
+    """provider_config rides cluster_info.json so the on-cluster daemon
+    can call the provider from the inside (autostop on GKE needs the
+    api_server)."""
+    info = common.ClusterInfo(
+        provider_name='gke', cluster_name='c', region='r', zone='z',
+        instances=[common.InstanceInfo(
+            instance_id='p', internal_ip='10.0.0.2', external_ip=None,
+            node_index=0, host_index=0)],
+        provider_config={'api_server': 'https://k8s.test',
+                         'namespace': 'ns'})
+    round_tripped = common.ClusterInfo.from_dict(info.to_dict())
+    assert round_tripped.provider_config['api_server'] == \
+        'https://k8s.test'
+
+
+def test_reuse_skips_existing_pods(fake_k8s):
+    svc = fake_k8s()
+    cfg = _config('v5e-16')
+    gke_instance.run_instances(cfg)
+    record = gke_instance.run_instances(cfg)
+    assert record.created_instance_ids == []
+    assert len(svc.pods) == 2
+
+
+def test_unmapped_topology_rejected():
+    import dataclasses
+    topo = tpu_topology.parse_tpu_type('v5e-8')
+    weird = dataclasses.replace(topo, num_chips=3)
+    with pytest.raises(exceptions.InvalidResourcesError):
+        gke_instance.gke_topology_label(weird)
+
+
+def test_kubectl_runner_spec_roundtrip():
+    from skypilot_tpu.utils import command_runner
+    runner = command_runner.runner_from_spec(
+        {'kind': 'kubectl', 'namespace': 'default',
+         'pod': 'kcluster-n0-h0', 'container': 'skyt'})
+    assert runner.pod == 'kcluster-n0-h0'
+    assert runner._base()[:3] == ['kubectl', '-n', 'default']
