@@ -175,6 +175,23 @@ def get_access_token() -> str:
     return token
 
 
+def gcloud_config_value(key: str) -> Optional[str]:
+    """`gcloud config get-value <key>`, or None (no gcloud / unset /
+    timeout). Shared by project-id and OS Login account resolution."""
+    if not shutil.which('gcloud'):
+        return None
+    try:
+        proc = subprocess.run(
+            ['gcloud', 'config', 'get-value', key],
+            capture_output=True, timeout=15, check=False)
+    except subprocess.TimeoutExpired:
+        return None
+    value = proc.stdout.decode().strip()
+    if proc.returncode != 0 or not value or value == '(unset)':
+        return None
+    return value
+
+
 def get_project_id(provider_config: Optional[Dict[str, Any]] = None) -> str:
     if provider_config and provider_config.get('project_id'):
         return provider_config['project_id']
@@ -182,15 +199,9 @@ def get_project_id(provider_config: Optional[Dict[str, Any]] = None) -> str:
         'GCP_PROJECT')
     if env:
         return env
-    if shutil.which('gcloud'):
-        try:
-            proc = subprocess.run(
-                ['gcloud', 'config', 'get-value', 'project'],
-                capture_output=True, timeout=15, check=False)
-            if proc.returncode == 0 and proc.stdout.strip():
-                return proc.stdout.decode().strip()
-        except subprocess.TimeoutExpired:
-            pass
+    value = gcloud_config_value('project')
+    if value:
+        return value
     if _maybe_on_gce():
         try:
             status, body = _urllib_transport(

@@ -29,7 +29,18 @@ def instance_body(project: str, zone: str, name: str, machine_type: str,
                                 'family/ubuntu-2204-lts'),
                   use_spot: bool = False,
                   network: str = 'global/networks/default',
-                  tags: Optional[List[str]] = None) -> Dict[str, Any]:
+                  tags: Optional[List[str]] = None,
+                  use_oslogin: bool = False,
+                  reservation: Optional[str] = None) -> Dict[str, Any]:
+    """`use_oslogin` switches key injection to the caller's OS Login
+    profile (reference: sky/authentication.py:149); `reservation` pins
+    the VM to a specific compute reservation (reference:
+    gcp_utils.py:66-167 specific_reservations)."""
+    if use_oslogin:
+        metadata_items = [{'key': 'enable-oslogin', 'value': 'TRUE'}]
+    else:
+        metadata_items = [{'key': 'ssh-keys',
+                           'value': f'{ssh_user}:{ssh_public_key}'}]
     body: Dict[str, Any] = {
         'name': name,
         'machineType': f'zones/{zone}/machineTypes/{machine_type}',
@@ -47,8 +58,7 @@ def instance_body(project: str, zone: str, name: str, machine_type: str,
                                'type': 'ONE_TO_ONE_NAT'}],
         }],
         'metadata': {
-            'items': [{'key': 'ssh-keys',
-                       'value': f'{ssh_user}:{ssh_public_key}'}],
+            'items': metadata_items,
         },
         'labels': dict(labels),
         'tags': {'items': tags or ['skypilot-tpu']},
@@ -57,6 +67,14 @@ def instance_body(project: str, zone: str, name: str, machine_type: str,
         body['scheduling'] = {
             'provisioningModel': 'SPOT',
             'instanceTerminationAction': 'STOP',
+        }
+    if reservation and not use_spot:
+        # Spot VMs cannot consume reservations; spot wins (same
+        # precedence as the TPU paths).
+        body['reservationAffinity'] = {
+            'consumeReservationType': 'SPECIFIC_RESERVATION',
+            'key': 'compute.googleapis.com/reservation-name',
+            'values': [reservation],
         }
     return body
 

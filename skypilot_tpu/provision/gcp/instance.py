@@ -55,7 +55,39 @@ def bootstrap_config(config: common.ProvisionConfig) -> common.ProvisionConfig:
     up-front; we rely on the default network + default service account and
     only create firewall rules when `ports:` asks for them.
     """
+    from skypilot_tpu import config as config_lib
     project = client.get_project_id(config.provider_config)
+    # OS Login (reference: sky/authentication.py:149): explicit config
+    # wins; otherwise auto-detect the project's enable-oslogin metadata.
+    # When active, import the framework key into the caller's profile
+    # and SSH as the profile's POSIX username.
+    use_oslogin = config.provider_config.get(
+        'use_oslogin', config_lib.get_nested(['gcp', 'use_oslogin'], None))
+    if use_oslogin is None:
+        try:
+            from skypilot_tpu.provision.gcp import oslogin
+            use_oslogin = oslogin.project_oslogin_enabled(project)
+        except Exception:  # noqa: BLE001 — metadata probe is best-effort
+            use_oslogin = False
+    if use_oslogin:
+        from skypilot_tpu import exceptions as exc
+        from skypilot_tpu.provision.gcp import oslogin
+        try:
+            posix_user = oslogin.import_ssh_key(
+                config.authentication.get('ssh_public_key', ''))
+        except client.GcpApiError as e:
+            # Typed, so the failover loop handles it (transient 429/503
+            # retries elsewhere; 401/403 is cloud-fatal).
+            raise client.classify_api_error(e, config.zone) from e
+        except exc.NoCloudAccessError as e:
+            raise exc.ProvisionError(
+                str(e), scope=exc.FailoverScope.CLOUD,
+                retryable=False) from e
+        config.authentication['ssh_user'] = posix_user
+        logger.info(f'OS Login active: SSH as {posix_user!r}.')
+    reservation = config.provider_config.get(
+        'reservation',
+        config_lib.get_nested(['gcp', 'specific_reservation'], None))
     config.provider_config.update({
         'project_id': project,
         'zone': config.zone,
@@ -63,10 +95,15 @@ def bootstrap_config(config: common.ProvisionConfig) -> common.ProvisionConfig:
         'num_nodes': config.num_nodes,
         'ssh_user': config.authentication.get('ssh_user', 'skyt'),
         'ssh_key_path': config.authentication.get('ssh_private_key', ''),
+        'use_oslogin': bool(use_oslogin),
+        'reservation': reservation,
         'use_queued_resources': config.provider_config.get(
             'use_queued_resources',
             bool(config.resources.tpu is not None and
                  config.resources.tpu.is_pod)),
+        'provision_timeout': config.provider_config.get(
+            'provision_timeout',
+            config_lib.get_nested(['gcp', 'provision_timeout'], None)),
     })
     return config
 
@@ -82,6 +119,7 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
     resumed: List[str] = []
 
     try:
+        reservation = config.provider_config.get('reservation')
         if res.tpu is not None:
             body = tpu_api.node_body(
                 tpu_type=res.tpu.accelerator_type,
@@ -92,7 +130,10 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                 labels=labels,
                 use_spot=res.use_spot,
                 network=config.provider_config.get('network'),
-                subnetwork=config.provider_config.get('subnetwork'))
+                subnetwork=config.provider_config.get('subnetwork'),
+                use_oslogin=config.provider_config.get('use_oslogin',
+                                                       False),
+                reserved=bool(reservation))
             use_qr = config.provider_config.get('use_queued_resources')
             for i in range(config.num_nodes):
                 name = _node_name(config.cluster_name, i)
@@ -110,10 +151,17 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                             f'TPU {name} in unexpected state {state}')
                     continue
                 if use_qr:
+                    timeout = config.provider_config.get(
+                        'provision_timeout')
                     tpu_api.create_queued_resource(
                         project, zone, qr_id=name, node_id=name,
-                        body=body, use_spot=res.use_spot)
-                    tpu_api.wait_queued_resource(project, zone, name)
+                        body=body, use_spot=res.use_spot,
+                        reserved=bool(reservation),
+                        valid_until_duration_s=(int(timeout)
+                                                if timeout else None))
+                    tpu_api.wait_queued_resource(
+                        project, zone, name,
+                        timeout_s=float(timeout) if timeout else 1800.0)
                 else:
                     op = tpu_api.create_node(project, zone, name, body)
                     tpu_api.wait_operation(op)
@@ -135,6 +183,9 @@ def run_instances(config: common.ProvisionConfig) -> common.ProvisionRecord:
                     project, zone, name, machine_type,
                     ssh_user=auth['ssh_user'],
                     ssh_public_key=auth['ssh_public_key'],
+                    use_oslogin=config.provider_config.get(
+                        'use_oslogin', False),
+                    reservation=reservation,
                     labels=labels,
                     disk_size_gb=res.disk_size_gb,
                     use_spot=res.use_spot,

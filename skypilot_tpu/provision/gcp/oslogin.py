@@ -1,0 +1,70 @@
+"""OS Login key management (reference: sky/authentication.py:149 —
+GCP projects with `enable-oslogin=TRUE` ignore per-instance ssh-keys
+metadata; keys must be imported into the caller's OS Login profile and
+SSH uses the profile's POSIX username instead of the local user).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import client
+
+logger = sky_logging.init_logger(__name__)
+
+_BASE = 'https://oslogin.googleapis.com/v1'
+# Imported keys expire; 10 days covers long launches and is re-imported
+# on every provision (the reference imports with no expiry; bounded is
+# safer for a shared project).
+_KEY_TTL_USEC = 10 * 24 * 3600 * 1_000_000
+
+
+def get_account_email() -> str:
+    """The Google account whose OS Login profile owns the key."""
+    email = os.environ.get('SKYT_GCP_ACCOUNT')
+    if email:
+        return email
+    email = client.gcloud_config_value('account')
+    if email:
+        return email
+    raise exceptions.NoCloudAccessError(
+        'OS Login needs the Google account email; set SKYT_GCP_ACCOUNT '
+        'or configure gcloud.')
+
+
+def project_oslogin_enabled(project: str) -> bool:
+    """Project-level enable-oslogin metadata (reference checks the same
+    project metadata before choosing the key-injection path)."""
+    proj = client.request(
+        'GET',
+        f'https://compute.googleapis.com/compute/v1/projects/{project}')
+    items = proj.get('commonInstanceMetadata', {}).get('items', [])
+    for item in items:
+        if item.get('key', '').lower() == 'enable-oslogin':
+            return str(item.get('value', '')).lower() == 'true'
+    return False
+
+
+def import_ssh_key(public_key_content: str,
+                   expire_usec: Optional[int] = None) -> str:
+    """Import the framework pubkey into the caller's OS Login profile;
+    returns the profile's primary POSIX username (the ssh_user for every
+    VM in the project)."""
+    import time
+    email = get_account_email()
+    expiry = expire_usec or int(time.time() * 1e6) + _KEY_TTL_USEC
+    resp = client.request(
+        'POST', f'{_BASE}/users/{email}:importSshPublicKey',
+        {'key': public_key_content, 'expirationTimeUsec': str(expiry)})
+    profile: Dict[str, Any] = resp.get('loginProfile', {})
+    accounts = profile.get('posixAccounts', [])
+    for acct in accounts:
+        if acct.get('primary'):
+            return acct['username']
+    if accounts:
+        return accounts[0]['username']
+    raise exceptions.ProvisionError(
+        f'OS Login profile for {email} has no POSIX account.',
+        scope=exceptions.FailoverScope.CLOUD, retryable=False)

@@ -30,16 +30,26 @@ def node_body(tpu_type: str, runtime_version: str,
               network: Optional[str] = None,
               subnetwork: Optional[str] = None,
               tags: Optional[List[str]] = None,
-              startup_script: Optional[str] = None) -> Dict[str, Any]:
+              startup_script: Optional[str] = None,
+              use_oslogin: bool = False,
+              reserved: bool = False) -> Dict[str, Any]:
     """Build the Node resource body for nodes.create.
 
-    ssh-keys metadata follows the TPU-VM convention (same as GCE:
-    `user:ssh-rsa ...` lines); reference injects keys via os-login or
-    metadata in sky/authentication.py:149.
+    Key injection follows sky/authentication.py:149: per-node ssh-keys
+    metadata normally, or the caller's OS Login profile when the project
+    enforces it (then `use_oslogin` drops the metadata — it would be
+    ignored — and the ssh user is the profile's POSIX name, resolved in
+    bootstrap_config). `reserved` consumes a TPU reservation
+    (reference: gcp_utils.py:66-167 reservation plumbing).
     """
-    metadata: Dict[str, str] = {
-        'ssh-keys': f'{ssh_user}:{ssh_public_key}',
-    }
+    metadata: Dict[str, str] = {}
+    if use_oslogin:
+        # Explicit opt-in must ACTIVATE OS Login on the node, not just
+        # drop the (ignored) ssh-keys item — otherwise neither key path
+        # is live and every host is unreachable.
+        metadata['enable-oslogin'] = 'TRUE'
+    else:
+        metadata['ssh-keys'] = f'{ssh_user}:{ssh_public_key}'
     if startup_script:
         metadata['startup-script'] = startup_script
     body: Dict[str, Any] = {
@@ -58,6 +68,8 @@ def node_body(tpu_type: str, runtime_version: str,
         body['networkConfig']['subnetwork'] = subnetwork
     if use_spot:
         body['schedulingConfig'] = {'spot': True}
+    elif reserved:
+        body['schedulingConfig'] = {'reserved': True}
     return body
 
 
@@ -94,6 +106,7 @@ def start_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
 def create_queued_resource(project: str, zone: str, qr_id: str,
                            node_id: str, body: Dict[str, Any],
                            use_spot: bool = False,
+                           reserved: bool = False,
                            valid_until_duration_s: Optional[int] = None
                            ) -> Dict[str, Any]:
     node = dict(body)
@@ -110,7 +123,9 @@ def create_queued_resource(project: str, zone: str, qr_id: str,
     if use_spot:
         qr['spot'] = {}
     else:
-        qr['guaranteed'] = {}
+        # reserved=True consumes the project's TPU reservation
+        # (reference: reservations plumbing, gcp_utils.py:66-167).
+        qr['guaranteed'] = {'reserved': True} if reserved else {}
     if valid_until_duration_s:
         qr['queueingPolicy'] = {
             'validUntilDuration': f'{valid_until_duration_s}s'}

@@ -57,9 +57,21 @@ def provision_with_failover(
     names (reference: provision_with_retries, cloud_vm_ray_backend.py:1980).
     """
     private_key, public_key = authentication.get_or_generate_keys()
+    # Providers embed the pubkey CONTENT in instance metadata (ssh-keys);
+    # the path rides along for anything that needs the file itself.
+    try:
+        with open(public_key) as f:
+            public_key_content = f.read().strip()
+    except OSError as e:
+        # Fail fast with the real cause — an empty key would 'provision'
+        # fine and only surface minutes later as SSH-unreachable.
+        raise exceptions.ProvisionError(
+            f'Cannot read SSH public key {public_key}: {e}',
+            scope=exceptions.FailoverScope.CLOUD, retryable=False) from e
     auth = {'ssh_user': os.environ.get('USER', 'skyt'),
             'ssh_private_key': private_key,
-            'ssh_public_key': public_key}
+            'ssh_public_key': public_key_content,
+            'ssh_public_key_path': public_key}
 
     blocked_zones: Set[str] = set()
     blocked_regions: Set[str] = set()

@@ -23,11 +23,14 @@ class FakeGcpService:
     """In-memory TPU v2 + GCE v1 REST service."""
 
     def __init__(self, stockout_zones=(), quota_fail=False,
-                 hosts_per_node=1):
+                 hosts_per_node=1, oslogin_project=False):
         self.tpu_nodes = {}       # (zone, name) -> node dict
         self.gce = {}             # (zone, name) -> instance dict
         self.queued = {}          # (zone, name) -> qr dict
+        self.qr_bodies = {}       # (zone, name) -> submitted QR body
         self.firewalls = {}       # name -> rule body
+        self.oslogin_project = oslogin_project
+        self.oslogin_keys = []    # imported pubkeys
         self.stockout_zones = set(stockout_zones)
         self.quota_fail = quota_fail
         self.hosts_per_node = hosts_per_node
@@ -51,9 +54,22 @@ class FakeGcpService:
             return self.route_tpu(method, m['z'], m['rest'], data)
         m = re.match(
             r'https://compute\.googleapis\.com/compute/v1/projects/'
-            r'(?P<p>[^/]+)/(?P<rest>.*)', url)
+            r'(?P<p>[^/]+)(/(?P<rest>.*))?$', url)
         if m:
+            if not m['rest']:
+                items = ([{'key': 'enable-oslogin', 'value': 'TRUE'}]
+                         if self.oslogin_project else [])
+                return 200, {'name': m['p'],
+                             'commonInstanceMetadata': {'items': items}}
             return self.route_gce(method, m['rest'], data)
+        m = re.match(
+            r'https://oslogin\.googleapis\.com/v1/users/'
+            r'(?P<email>[^:]+):importSshPublicKey', url)
+        if m:
+            self.oslogin_keys.append(data.get('key', ''))
+            user = m['email'].replace('@', '_').replace('.', '_')
+            return 200, {'loginProfile': {'posixAccounts': [
+                {'primary': True, 'username': user}]}}
         return self._err(404, 'NOT_FOUND', f'no route {url}')
 
     # -- TPU API ------------------------------------------------------- #
@@ -115,6 +131,7 @@ class FakeGcpService:
                     self._make_node(zone, spec['nodeId'], spec['node'])
                     self.queued[(zone, qr_id)] = {
                         'state': {'state': 'ACTIVE'}}
+                    self.qr_bodies[(zone, qr_id)] = data
                 return 200, {'done': True}
             qr_id = rest.split('/', 1)[1].split('?')[0]
             qr = self.queued.get((zone, qr_id))
@@ -141,6 +158,7 @@ class FakeGcpService:
                         'The zone does not have enough resources')
                 name = data['name']
                 self.gce[(zone, name)] = {
+                    **data,
                     'name': name, 'status': 'RUNNING',
                     'networkInterfaces': [{
                         'networkIP': f'10.1.0.{len(self.gce) + 2}',
@@ -372,3 +390,64 @@ def test_open_ports_creates_then_patches_rule(fake_gcp):
     compute_api.cleanup_ports('proj', 'c1')
     assert 'skyt-c1-ports' not in svc.firewalls
     compute_api.cleanup_ports('proj', 'c1')  # idempotent on 404
+
+
+def test_oslogin_project_switches_key_injection(fake_gcp, monkeypatch):
+    """Project with enable-oslogin=TRUE (reference:
+    sky/authentication.py:149): the framework key is imported into the
+    caller's OS Login profile, SSH user becomes the profile's POSIX
+    name, and per-node ssh-keys metadata is dropped (it would be
+    ignored)."""
+    svc = fake_gcp(oslogin_project=True)
+    monkeypatch.setenv('SKYT_GCP_ACCOUNT', 'dev@example.com')
+    cfg = _tpu_config('v5e-8', zone='us-west1-c')
+    assert cfg.authentication['ssh_user'] == 'dev_example_com'
+    assert svc.oslogin_keys == ['ssh-rsa AAA']
+    gcp_instance.run_instances(cfg)
+    node = svc.tpu_nodes[('us-west1-c', 'mycluster-0')]
+    assert 'ssh-keys' not in node['metadata']
+
+
+def test_no_oslogin_keeps_metadata_keys(fake_gcp):
+    svc = fake_gcp()
+    cfg = _tpu_config('v5e-8', zone='us-west1-c')
+    gcp_instance.run_instances(cfg)
+    node = svc.tpu_nodes[('us-west1-c', 'mycluster-0')]
+    assert node['metadata']['ssh-keys'] == 'skyt:ssh-rsa AAA'
+
+
+def test_reservation_threads_to_tpu_and_gce(fake_gcp):
+    """gcp.specific_reservation: TPU queued resources consume the
+    reservation (guaranteed.reserved), direct creates set
+    schedulingConfig.reserved, GCE VMs pin reservationAffinity
+    (reference: gcp_utils.py:66-167, mig_utils.py)."""
+    svc = fake_gcp(hosts_per_node=4)
+    # Pod slice -> queued resources path.
+    res = resources_lib.Resources(
+        cloud='gcp', tpu=tpu_topology.parse_tpu_type('v5p-16'),
+        zone='us-east5-a')
+    cfg = common.ProvisionConfig(
+        cluster_name='mycluster', cloud='gcp', region='us-east5',
+        zone='us-east5-a', num_nodes=1, resources=res,
+        authentication={'ssh_user': 'skyt', 'ssh_public_key': 'ssh-rsa AAA',
+                        'ssh_private_key': '/tmp/k'},
+        provider_config={'project_id': 'proj', 'reservation': 'res1'})
+    cfg = gcp_instance.bootstrap_config(cfg)
+    gcp_instance.run_instances(cfg)
+    qr = svc.qr_bodies[('us-east5-a', 'mycluster-0')]
+    assert qr['guaranteed'] == {'reserved': True}
+
+    # GCE controller VM -> reservationAffinity.
+    res2 = resources_lib.Resources(cloud='gcp',
+                                   instance_type='e2-standard-4',
+                                   zone='us-central1-a')
+    cfg2 = common.ProvisionConfig(
+        cluster_name='ctrl', cloud='gcp', region='us-central1',
+        zone='us-central1-a', num_nodes=1, resources=res2,
+        authentication={'ssh_user': 'skyt', 'ssh_public_key': 'ssh-rsa AAA',
+                        'ssh_private_key': '/tmp/k'},
+        provider_config={'project_id': 'proj', 'reservation': 'res1'})
+    cfg2 = gcp_instance.bootstrap_config(cfg2)
+    gcp_instance.run_instances(cfg2)
+    inst = svc.gce[('us-central1-a', 'ctrl-0')]
+    assert inst.get('reservationAffinity', {}).get('values') == ['res1']
