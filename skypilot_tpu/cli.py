@@ -432,6 +432,13 @@ def serve_down(service_name, controller, yes):
     print(f'Service {service_name!r} torn down.')
 
 
+@serve.command(name='dashboard')
+@click.option('--port', '-p', type=int, default=8124)
+def serve_dashboard(port):
+    from skypilot_tpu.serve import dashboard
+    dashboard.serve(port=port)
+
+
 @cli.group()
 def bench():
     """Benchmark a task across candidate TPU types (reference: sky bench)."""

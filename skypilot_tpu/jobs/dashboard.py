@@ -5,9 +5,7 @@ plus a JSON endpoint (/api/jobs) for tooling."""
 from __future__ import annotations
 
 import html
-import json
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from skypilot_tpu.jobs import core as jobs_core
 
@@ -39,7 +37,7 @@ _PAGE = """<!doctype html>
 
 def _render() -> str:
     rows = []
-    for j in jobs_core.queue():
+    for j in _jobs():
         status = j['status']
         color = _STATUS_COLORS.get(status, '#cf222e')
         sub = time.strftime('%m-%d %H:%M',
@@ -58,32 +56,20 @@ def _render() -> str:
                         rows='\n'.join(rows))
 
 
-class _Handler(BaseHTTPRequestHandler):
-
-    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-        if self.path.startswith('/api/jobs'):
-            body = json.dumps(jobs_core.queue()).encode()
-            ctype = 'application/json'
-        else:
-            body = _render().encode()
-            ctype = 'text/html; charset=utf-8'
-        self.send_response(200)
-        self.send_header('Content-Type', ctype)
-        self.send_header('Content-Length', str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def log_message(self, *args):  # quiet
-        del args
-
-
-def serve(host: str = '127.0.0.1', port: int = 8123) -> None:
-    server = ThreadingHTTPServer((host, port), _Handler)
-    print(f'Jobs dashboard: http://{host}:{server.server_address[1]}')
-    server.serve_forever()
+def _jobs():
+    # queue_all: VM-mode managed jobs (--controller vm) must be visible,
+    # same data `skyt jobs queue` shows.
+    return jobs_core.queue_all()
 
 
 def make_server(host: str = '127.0.0.1',
-                port: int = 0) -> ThreadingHTTPServer:
+                port: int = 0):
     """Bind-only variant for embedding/tests (port 0 = ephemeral)."""
-    return ThreadingHTTPServer((host, port), _Handler)
+    from skypilot_tpu.utils import dashboard as dash_lib
+    return dash_lib.make_server(_render, '/api/jobs', _jobs,
+                                host=host, port=port)
+
+
+def serve(host: str = '127.0.0.1', port: int = 8123) -> None:
+    from skypilot_tpu.utils import dashboard as dash_lib
+    dash_lib.serve_forever('Jobs', make_server(host, port))

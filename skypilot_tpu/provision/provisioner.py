@@ -88,11 +88,17 @@ def provision_with_failover(
         try:
             logger.info(f'Provisioning {cluster_name!r} '
                         f'({num_nodes}x {resources}) in {zone}...')
-            config = provision.bootstrap_config(cloud, config)
-            record = provision.run_instances(cloud, config)
-            provision.wait_instances(cloud, region, cluster_name,
-                                     common.InstanceStatus.RUNNING,
-                                     config.provider_config)
+            # Per-attempt sub-stage spans: launch->first-step wallclock
+            # (BASELINE north-star 1) decomposes into bootstrap / create
+            # / boot-wait per zone tried, not one opaque provision blob.
+            with timeline.Event('provision.bootstrap', zone=zone):
+                config = provision.bootstrap_config(cloud, config)
+            with timeline.Event('provision.run_instances', zone=zone):
+                record = provision.run_instances(cloud, config)
+            with timeline.Event('provision.wait_instances', zone=zone):
+                provision.wait_instances(cloud, region, cluster_name,
+                                         common.InstanceStatus.RUNNING,
+                                         config.provider_config)
             info = provision.get_cluster_info(cloud, region, cluster_name,
                                               config.provider_config)
             # Ship the provider bookkeeping to the head (cluster_info
@@ -199,6 +205,7 @@ def setup_runtime_on_cluster(info: common.ClusterInfo) -> None:
                 check=False)
 
 
+@timeline.event
 def start_agent_daemon(info: common.ClusterInfo) -> None:
     """Start the head daemon (autostop + controller-liveness events;
     reference: skylet start, instance_setup.py:440). Idempotent via

@@ -1,0 +1,41 @@
+"""Shared dashboard scaffolding for the jobs/serve dashboards: one
+stdlib HTTP server shape (HTML page + JSON API), so fixes land once."""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+
+def make_server(render_fn: Callable[[], str],
+                api_path: str,
+                api_fn: Callable[[], object],
+                host: str = '127.0.0.1',
+                port: int = 0) -> ThreadingHTTPServer:
+    """HTML at '/', JSON at `api_path`; port 0 = ephemeral."""
+
+    class Handler(BaseHTTPRequestHandler):
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path.startswith(api_path):
+                body = json.dumps(api_fn()).encode()
+                ctype = 'application/json'
+            else:
+                body = render_fn().encode()
+                ctype = 'text/html; charset=utf-8'
+            self.send_response(200)
+            self.send_header('Content-Type', ctype)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            del args
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_forever(name: str, server: ThreadingHTTPServer) -> None:
+    host, port = server.server_address[:2]
+    print(f'{name} dashboard: http://{host}:{port}')
+    server.serve_forever()

@@ -74,3 +74,30 @@ def event(fn: Callable) -> Callable:
             return fn(*args, **kwargs)
 
     return wrapper
+
+
+def summarize(path: str) -> str:
+    """Human-readable span table from a recorded trace file — the quick
+    look at where launch->first-step went without opening perfetto."""
+    with open(os.path.expanduser(path)) as f:
+        events = json.load(f).get('traceEvents', [])
+    if not events:
+        return '(no events)'
+    t0 = min(e['ts'] for e in events)
+    lines = [f"{'START':>9}  {'DUR':>9}  NAME"]
+    for e in sorted(events, key=lambda e: e['ts']):
+        start = (e['ts'] - t0) / 1e6
+        dur = e.get('dur', 0) / 1e6
+        args = e.get('args') or {}
+        suffix = (' [' + ', '.join(f'{k}={v}' for k, v in args.items())
+                  + ']') if args else ''
+        lines.append(f'{start:>8.2f}s  {dur:>8.2f}s  {e["name"]}{suffix}')
+    return '\n'.join(lines)
+
+
+if __name__ == '__main__':
+    import sys
+    try:
+        print(summarize(sys.argv[1]))
+    except BrokenPipeError:  # `... | head` closed the pipe
+        pass

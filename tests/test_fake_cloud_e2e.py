@@ -308,3 +308,38 @@ def test_retry_until_up_waits_for_capacity(monkeypatch):
     # It actually waited through at least one stocked-out sweep.
     assert time.time() - t0 >= 3
     assert core.job_status('retryup', job_id) == 'SUCCEEDED'
+
+
+def test_timeline_decomposes_launch(monkeypatch, tmp_path):
+    """SKYT_TIMELINE_FILE records provision sub-stage spans (bootstrap /
+    run_instances / wait) per zone plus the runtime-setup stages, so
+    launch->first-step decomposes (BASELINE north-star 1)."""
+    import json as json_lib
+    import subprocess
+    import sys
+    trace = tmp_path / 'trace.json'
+    code = (
+        "import skypilot_tpu as sky\n"
+        "t = sky.Task(name='tl', run='true')\n"
+        "t.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',"
+        " cloud='fake'))\n"
+        "sky.launch(t, cluster_name='tl', quiet_optimizer=True)\n")
+    proc = subprocess.run(
+        [sys.executable, '-c', code], capture_output=True, text=True,
+        timeout=180,
+        env={**os.environ, 'SKYT_TIMELINE_FILE': str(trace),
+             'PYTHONPATH': os.path.dirname(os.path.dirname(
+                 os.path.abspath(sky.__file__)))})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    events = {e['name'] for e in
+              json_lib.loads(trace.read_text())['traceEvents']}
+    for expected in ('provision.bootstrap', 'provision.run_instances',
+                     'provision.wait_instances'):
+        assert expected in events, events
+    assert any('provision_with_failover' in e for e in events)
+    assert any('setup_runtime_on_cluster' in e for e in events)
+    assert any('start_agent_daemon' in e for e in events)
+    # The summary tool renders it.
+    from skypilot_tpu.utils import timeline
+    out = timeline.summarize(str(trace))
+    assert 'provision.run_instances' in out

@@ -158,3 +158,29 @@ def test_state_db_migration(tmp_path, monkeypatch):
     assert svc['version'] == 1
     assert svc['task_yaml'] is None
     assert state.get_services()[0]['name'] == 'old-svc'
+
+
+def test_serve_dashboard(fast_tick):
+    """Dashboard renders services + replicas and serves JSON (round-2
+    verdict #10: serve-side dashboard mirroring jobs/dashboard.py)."""
+    import json
+    import threading
+    import urllib.request as _url
+    port = _free_port()
+    name = serve_core.up(_serve_task(port), service_name='dash')
+    _wait_ready(name, 1)
+    from skypilot_tpu.serve import dashboard
+    server = dashboard.make_server(port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        dport = server.server_address[1]
+        page = _url.urlopen(f'http://127.0.0.1:{dport}/').read().decode()
+        assert 'dash' in page and 'READY' in page
+        api = json.loads(_url.urlopen(
+            f'http://127.0.0.1:{dport}/api/services').read())
+        assert any(s['name'] == 'dash' for s in api)
+        assert api[0]['replicas']
+    finally:
+        server.shutdown()
+        serve_core.down(name)
