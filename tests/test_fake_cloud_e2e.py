@@ -343,3 +343,39 @@ def test_timeline_decomposes_launch(monkeypatch, tmp_path):
     from skypilot_tpu.utils import timeline
     out = timeline.summarize(str(trace))
     assert 'provision.run_instances' in out
+
+
+def test_gang_drives_real_jax_distributed():
+    """The env contract is not just strings: a 2-host gang on the fake
+    cloud runs REAL jax.distributed.initialize from SKYT_* (coordinator
+    on host 0, process_id = TPU worker id) and a cross-process pmap
+    psum sees every device (SURVEY §7 hard part: getting rank/coord
+    wrong deadlocks silently — this exercises the real rendezvous, not
+    an env echo)."""
+    # Fake internal IPs are not routable; hosts share localhost. Pick a
+    # free port so concurrent pytest runs on one machine cannot collide
+    # (or worse, rendezvous with the wrong run's coordinator).
+    import socket
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        coord_port = s.getsockname()[1]
+    run = (
+        f'export SKYT_COORDINATOR_ADDRESS=127.0.0.1:{coord_port}\n'
+        'python3 - <<PYEOF\n'
+        'from skypilot_tpu.parallel import distributed\n'
+        'import jax, jax.numpy as jnp\n'
+        'assert distributed.initialize_from_env(timeout_s=120)\n'
+        'n = jax.process_count()\n'
+        'total = jax.device_count()\n'
+        'out = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(\n'
+        '    jnp.ones(jax.local_device_count()))\n'
+        'print(f"DIST nproc={n} devices={total} psum={float(out[0])}")\n'
+        'PYEOF\n')
+    job_id, handle = sky.launch(
+        _task(run, accel='tpu-v5e-16', name='dist'),
+        cluster_name='dist', quiet_optimizer=True)
+    assert handle.cluster_info.num_hosts == 2
+    assert _wait_job('dist', job_id, timeout=180) == 'SUCCEEDED'
+    log0 = _rank_log('dist', job_id, 'run', 0)
+    # 2 processes x 8 virtual CPU devices each; psum of ones = 16.
+    assert 'DIST nproc=2 devices=16 psum=16.0' in log0
