@@ -74,6 +74,65 @@ def make_mesh(shape: MeshShape,
     return Mesh(device_array, AXIS_ORDER)
 
 
+def make_multislice_mesh(shape: MeshShape, num_slices: int,
+                         devices: Optional[Sequence[jax.Device]] = None,
+                         dcn_axis: str = 'dp') -> Mesh:
+    """Mesh spanning `num_slices` TPU slices connected over DCN
+    (multislice training; MEGASCALE_* env exported by the gang
+    executor). The `dcn_axis` ('dp' or 'pp' — the low-traffic axes) is
+    laid ACROSS slices; every other axis stays inside a slice on ICI.
+
+    Uses mesh_utils.create_hybrid_device_mesh when the backend exposes
+    slice topology (real multislice TPU); on backends without
+    slice_index (CPU meshes in tests, single slice) falls back to
+    contiguous per-slice blocks, which matches how jax.devices() orders
+    devices by process.
+    """
+    if dcn_axis not in ('dp', 'pp'):
+        raise ValueError(
+            f'dcn_axis must be dp or pp (the low-traffic axes), '
+            f'got {dcn_axis!r}')
+    if devices is None:
+        devices = jax.devices()
+    dcn_size = getattr(shape, dcn_axis)
+    if dcn_size % num_slices != 0:
+        raise ValueError(
+            f'{dcn_axis}={dcn_size} must be divisible by num_slices='
+            f'{num_slices} (the DCN axis is laid across slices).')
+    if shape.total != len(devices):
+        raise ValueError(
+            f'Mesh {shape} needs {shape.total} devices, have '
+            f'{len(devices)}.')
+    per_slice = {a: getattr(shape, a) for a in AXIS_ORDER}
+    per_slice[dcn_axis] //= num_slices
+    dcn = {a: (num_slices if a == dcn_axis else 1) for a in AXIS_ORDER}
+    order = lambda d: tuple(d[a] for a in AXIS_ORDER)  # noqa: E731
+    slice_ids = {getattr(d, 'slice_index', None) for d in devices}
+    if None not in slice_ids:
+        # Real multislice topology: misconfiguration must ERROR, not
+        # fall back — a process-order layout that straddles actual
+        # slice boundaries puts the ICI axes on DCN silently.
+        if len(slice_ids) != num_slices:
+            raise ValueError(
+                f'devices span {len(slice_ids)} slices but '
+                f'num_slices={num_slices}.')
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            order(per_slice), order(dcn), devices=devices)
+    else:
+        # No slice topology (CPU / single-process tests): contiguous
+        # blocks of len(devices)/num_slices per slice, matching
+        # jax.devices() process ordering.
+        import numpy as np
+        arr = np.asarray(devices, dtype=object)
+        arr = arr.reshape(num_slices, -1)
+        blocks = [a.reshape(order(per_slice)) for a in arr]
+        device_array = np.stack(blocks, axis=AXIS_ORDER.index(dcn_axis))
+        # Merge the slice dim into the dcn axis.
+        device_array = device_array.reshape(order({
+            **per_slice, dcn_axis: per_slice[dcn_axis] * num_slices}))
+    return Mesh(device_array, AXIS_ORDER)
+
+
 def default_mesh_shape(num_devices: int,
                        tp: int = 1, sp: int = 1, ep: int = 1,
                        dp: Optional[int] = None) -> MeshShape:

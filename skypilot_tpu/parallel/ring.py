@@ -62,7 +62,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     lse0 = jnp.full((b, h, c), -1e30, jnp.float32)
     # Mark the accumulators as device-varying along the ring axis so the
     # scan carry type matches its (my_idx-dependent) outputs.
-    o0, lse0 = jax.lax.pvary((o0, lse0), (axis_name,))
+    if hasattr(jax.lax, 'pcast'):  # jax >= 0.8.1 spelling
+        o0, lse0 = jax.lax.pcast((o0, lse0), (axis_name,), to='varying')
+    else:
+        o0, lse0 = jax.lax.pvary((o0, lse0), (axis_name,))
 
     def step(carry, i):
         o, lse, kc, vc = carry

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
 
 from skypilot_tpu.ops import flash_attention as fa
 from skypilot_tpu.parallel import mesh as mesh_lib
@@ -33,7 +33,7 @@ def test_ring_matches_full(sp_mesh, h, kv):
     ref, _ = fa.reference_attention_hsd(q, k, v, causal=True)
 
     spec = P(None, None, 'sp', None)
-    ring_fn = shard_map(
+    ring_fn = mesh_lib.compat_shard_map(
         functools.partial(ring.ring_attention, axis_name='sp'),
         mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec)
     out = jax.jit(ring_fn)(q, k, v)
@@ -47,7 +47,7 @@ def test_ring_noncausal(sp_mesh):
         _rand(6, (b, h, s, d))
     ref, _ = fa.reference_attention_hsd(q, k, v, causal=False)
     spec = P(None, None, 'sp', None)
-    ring_fn = shard_map(
+    ring_fn = mesh_lib.compat_shard_map(
         functools.partial(ring.ring_attention, axis_name='sp',
                           causal=False),
         mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -62,7 +62,7 @@ def test_ring_grads_flow(sp_mesh):
     q, k, v = _rand(7, (b, h, s, d)), _rand(8, (b, h, s, d)), \
         _rand(9, (b, h, s, d))
     spec = P(None, None, 'sp', None)
-    ring_fn = shard_map(
+    ring_fn = mesh_lib.compat_shard_map(
         functools.partial(ring.ring_attention, axis_name='sp'),
         mesh=sp_mesh, in_specs=(spec, spec, spec), out_specs=spec)
 
