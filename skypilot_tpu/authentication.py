@@ -13,20 +13,32 @@ from skypilot_tpu import config as config_lib
 
 
 def get_or_generate_keys() -> Tuple[str, str]:
-    """Returns (private_key_path, public_key_path)."""
+    """Returns (private_key_path, public_key_path).
+
+    Generation is serialized under a file lock: parallel launches (the
+    benchmark fan-out, concurrent jobs) otherwise race keygen — one
+    caller can observe the private key written but the .pub not yet."""
+    from skypilot_tpu.utils import subprocess_utils
     key_dir = config_lib.home_dir() / 'keys'
     key_dir.mkdir(parents=True, exist_ok=True, mode=0o700)
     private = key_dir / 'skyt-key'
     public = key_dir / 'skyt-key.pub'
-    if not private.exists():
-        try:
-            subprocess.run(
-                ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f',
-                 str(private), '-C', 'skypilot-tpu'],
-                check=True)
-        except FileNotFoundError:
-            _generate_keys_python(private, public)
-        os.chmod(private, 0o600)
+    if private.exists() and public.exists():
+        return str(private), str(public)
+    with subprocess_utils.file_lock(str(key_dir / '.keygen.lock')):
+        if not (private.exists() and public.exists()):
+            # Clear partial state (crashed generation): ssh-keygen
+            # refuses to overwrite an existing private key.
+            private.unlink(missing_ok=True)
+            public.unlink(missing_ok=True)
+            try:
+                subprocess.run(
+                    ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f',
+                     str(private), '-C', 'skypilot-tpu'],
+                    check=True)
+            except FileNotFoundError:
+                _generate_keys_python(private, public)
+            os.chmod(private, 0o600)
     return str(private), str(public)
 
 
