@@ -432,6 +432,23 @@ def serve_down(service_name, controller, yes):
     print(f'Service {service_name!r} torn down.')
 
 
+@serve.command(name='logs')
+@click.argument('service_name')
+@click.option('--replica', '-r', type=int, default=None,
+              help='Tail this replica\'s job log instead of the '
+                   'controller log.')
+@click.option('--no-follow', is_flag=True)
+@click.option('--controller', type=click.Choice(['local', 'vm']),
+              default='local')
+def serve_logs(service_name, replica, no_follow, controller):
+    from skypilot_tpu.serve import core as serve_core
+    if controller == 'vm':
+        sys.exit(serve_core.vm_tail_logs(service_name, replica_id=replica,
+                                         follow=not no_follow))
+    sys.exit(serve_core.tail_logs(service_name, replica_id=replica,
+                                  follow=not no_follow))
+
+
 @serve.command(name='dashboard')
 @click.option('--port', '-p', type=int, default=8124)
 def serve_dashboard(port):

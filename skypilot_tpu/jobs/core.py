@@ -273,29 +273,17 @@ def tail_logs(job_id: int, follow: bool = True) -> int:
         print(f'Managed job {job_id} not found.', file=sys.stderr)
         return 2
     path = record['log_path']
-    offset = 0
+    from skypilot_tpu.utils import log_utils
+    latest = {'record': record}
 
-    def _pump() -> int:
-        nonlocal offset
-        if os.path.exists(path):
-            with open(path, 'r', errors='replace') as f:
-                f.seek(offset)
-                chunk = f.read()
-                offset = f.tell()
-            if chunk:
-                print(chunk, end='', flush=True)
-        return offset
+    def _is_done() -> bool:
+        latest['record'] = state.get_job(job_id)
+        return latest['record']['status'].is_terminal()
 
-    while True:
-        # Check status BEFORE the final pump so lines written between the
-        # read and a terminal transition are not dropped.
-        record = state.get_job(job_id)
-        terminal = record['status'].is_terminal()
-        _pump()
-        if terminal:
-            print(f'[skyt] Managed job {job_id} {record["status"].value}.')
-            return 0 if record['status'] == \
-                state.ManagedJobStatus.SUCCEEDED else 100
-        if not follow:
-            return 0
-        time.sleep(0.5)
+    log_utils.tail_file(path, follow, _is_done)
+    record = latest['record']
+    if record['status'].is_terminal():
+        print(f'[skyt] Managed job {job_id} {record["status"].value}.')
+        return 0 if record['status'] == \
+            state.ManagedJobStatus.SUCCEEDED else 100
+    return 0

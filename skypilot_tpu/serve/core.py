@@ -267,6 +267,55 @@ def vm_update(service_name: str, task: task_lib.Task) -> int:
     return result['version']
 
 
+def tail_logs(service_name: str, replica_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    """`skyt serve logs` (reference: sky serve logs — controller log by
+    default, a replica's job log with --replica). Returns an exit code."""
+    svc = state.get_service(service_name)
+    if svc is None:
+        raise exceptions.SkyTpuError(
+            f'Service {service_name!r} not found.')
+    if replica_id is not None:
+        replicas = {r['replica_id']: r
+                    for r in state.get_replicas(service_name)}
+        if replica_id not in replicas:
+            raise exceptions.SkyTpuError(
+                f'Service {service_name!r} has no replica {replica_id} '
+                f'(have {sorted(replicas)}).')
+        from skypilot_tpu import core
+        return core.tail_logs(replicas[replica_id]['cluster_name'], 1,
+                              follow=follow)
+    log_path = str(config_lib.home_dir() / 'serve' / service_name
+                   / 'controller.log')
+    from skypilot_tpu.utils import log_utils
+    gone = {'flag': False}
+
+    def _is_done() -> bool:
+        gone['flag'] = state.get_service(service_name) is None
+        return gone['flag']
+
+    log_utils.tail_file(log_path, follow, _is_done)
+    if follow and gone['flag']:
+        print(f'[skyt] Service {service_name!r} is gone.')
+    return 0
+
+
+def vm_tail_logs(service_name: str, replica_id: Optional[int] = None,
+                 follow: bool = True) -> int:
+    """Stream a VM-mode service's controller/replica log to this tty."""
+    from skypilot_tpu.utils import controller_utils
+    handle = _vm_handle()
+    if handle is None:
+        raise exceptions.SkyTpuError('No serve controller cluster is up.')
+    args = ['logs', '--service-name', service_name]
+    if replica_id is not None:
+        args += ['--replica', str(replica_id)]
+    if not follow:
+        args.append('--no-follow')
+    return controller_utils.rpc(handle, 'skypilot_tpu.serve.rpc', args,
+                                stream=True)
+
+
 def down(service_name: str, timeout: float = 120) -> None:
     svc = state.get_service(service_name)
     if svc is None:

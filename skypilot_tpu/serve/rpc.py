@@ -31,6 +31,10 @@ def main() -> int:
     p_update = sub.add_parser('update')
     p_update.add_argument('--service-name', required=True)
     p_update.add_argument('--task-yaml', required=True)
+    p_logs = sub.add_parser('logs')
+    p_logs.add_argument('--service-name', required=True)
+    p_logs.add_argument('--replica', type=int, default=None)
+    p_logs.add_argument('--no-follow', action='store_true')
     args = parser.parse_args()
 
     from skypilot_tpu import task as task_lib
@@ -53,6 +57,16 @@ def main() -> int:
         version = serve_core.update(args.service_name, task)
         _print_json({'version': version})
         return 0
+    if args.cmd == 'logs':
+        from skypilot_tpu import exceptions
+        try:
+            return serve_core.tail_logs(args.service_name,
+                                        replica_id=args.replica,
+                                        follow=not args.no_follow)
+        except exceptions.SkyTpuError as e:
+            # Streamed verbatim to the client tty — keep it clean.
+            print(f'[skyt] {e}', file=sys.stderr)
+            return 2
     return 2
 
 

@@ -1,0 +1,33 @@
+"""Log tailing shared by jobs/serve `logs` verbs (reference analog:
+log_lib._follow_job_logs, sky/skylet/log_lib.py:302-450)."""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable
+
+
+def tail_file(path: str, follow: bool, is_done: Callable[[], bool],
+              poll_s: float = 0.5) -> None:
+    """Print `path` incrementally until `is_done()` (or once, when not
+    following). `is_done` is evaluated BEFORE each pump so lines written
+    between the last read and the terminal transition are never dropped
+    — the final pump always runs after the done signal."""
+    offset = 0
+
+    def _pump() -> None:
+        nonlocal offset
+        if os.path.exists(path):
+            with open(path, 'r', errors='replace') as f:
+                f.seek(offset)
+                chunk = f.read()
+                offset = f.tell()
+            if chunk:
+                print(chunk, end='', flush=True)
+
+    while True:
+        done = is_done()
+        _pump()
+        if done or not follow:
+            return
+        time.sleep(poll_s)

@@ -184,3 +184,26 @@ def test_serve_dashboard(fast_tick):
     finally:
         server.shutdown()
         serve_core.down(name)
+
+
+def test_serve_logs(fast_tick, capsys):
+    """`skyt serve logs`: controller log by default, a replica's job log
+    with --replica (reference: sky serve logs)."""
+    port = _free_port()
+    name = serve_core.up(_serve_task(port), service_name='slogs')
+    try:
+        _wait_ready(name, 1)
+        rc = serve_core.tail_logs(name, follow=False)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert 'replica' in out.lower() or 'Load balancer' in out
+        [rep] = serve_core.status(name)[0]['replicas']
+        rc = serve_core.tail_logs(name, replica_id=rep['replica_id'],
+                                  follow=False)
+        assert rc == 0
+        import pytest as _pytest
+        from skypilot_tpu import exceptions as exc
+        with _pytest.raises(exc.SkyTpuError):
+            serve_core.tail_logs(name, replica_id=99)
+    finally:
+        serve_core.down(name)
