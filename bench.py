@@ -101,6 +101,38 @@ def _flagship_projection(device, peak: float):
     }
 
 
+def _serving_throughput(device):
+    """Decode throughput of the in-framework serving engine (continuous
+    batching, greedy) with llama3-1b geometry on this chip — the serving
+    analog of the reference's JetStream numbers (BASELINE config 3:
+    Llama-2-7B on v6e, ~2148 output tok/s). Best-effort: a failure here
+    must never sink the training metric."""
+    import time as time_lib
+    try:
+        from skypilot_tpu.models import llama
+        from skypilot_tpu.serve import engine as engine_lib
+        cfg = llama.llama3_1b()
+        eng = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=16, max_decode_len=256,
+                prefill_buckets=(64,),
+                decode_chunk=32))   # offline: throughput over latency
+        prompts = [[1] * 32 for _ in range(16)]
+        eng.generate_batch(prompts, max_new_tokens=8)   # warmup/compile
+        t0 = time_lib.perf_counter()
+        out = eng.generate_batch(prompts, max_new_tokens=128)
+        dt = time_lib.perf_counter() - t0
+        tokens = sum(len(o) for o in out)
+        return {
+            'model': 'llama3-1b',
+            'batch_size': 16,
+            'output_tok_per_s': round(tokens / dt, 1),
+            'measured_on': device.device_kind,
+        }
+    except Exception as e:  # noqa: BLE001 — optional metric
+        return {'error': str(e)[:200]}
+
+
 def main() -> None:
     import jax
     from skypilot_tpu.models import llama
@@ -123,8 +155,10 @@ def main() -> None:
     mfu_pct, tok_per_s = _measure_mfu(cfg, batch, seq, steps, peak)
 
     flagship_report = None
+    serving_report = None
     if on_tpu:
         flagship_report = _flagship_projection(device, peak)
+        serving_report = _serving_throughput(device)
 
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
@@ -134,6 +168,7 @@ def main() -> None:
                 f'params, seq {seq}, {device.device_kind or "cpu"})',
         'vs_baseline': round(mfu_pct / REF_MFU_PCT, 2),
         'flagship': flagship_report,
+        'serving': serving_report,
     }))
 
 

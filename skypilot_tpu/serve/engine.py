@@ -46,6 +46,12 @@ class EngineConfig:
     prefill_buckets: Tuple[int, ...] = (16, 64, 256, 1024)
     eos_id: int = -1                  # -1: never stop on a token
     temperature: float = 0.0          # 0 => greedy
+    # Offline (generate_batch) decode steps fused into ONE device
+    # program via lax.scan: amortizes per-step dispatch (Python + a
+    # host<->device sync per token otherwise dominates small-model
+    # decode; through remote-execution relays each sync is a network
+    # round trip). The online run_loop stays at 1 for token latency.
+    decode_chunk: int = 8
 
 
 @dataclasses.dataclass
@@ -88,6 +94,9 @@ class Engine:
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
             donate_argnums=(1,))
+        self._decode_many_jit = jax.jit(
+            functools.partial(self._decode_many_impl, cfg=model_cfg),
+            static_argnames=('k',), donate_argnums=(1,))
 
     # -- device programs ------------------------------------------------ #
 
@@ -125,6 +134,22 @@ class Engine:
                                               tokens, cfg)
         next_tokens = self._sample(logits, key, self.cfg.temperature)
         return next_tokens, new_cache, lengths + 1
+
+    def _decode_many_impl(self, params, cache, lengths, tokens, key, k,
+                          cfg):
+        """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
+        One dispatch + one host transfer per k tokens."""
+        def body(carry, subkey):
+            cache, lengths, tokens = carry
+            logits, cache = llama.decode_step(params, cache, lengths,
+                                              tokens, cfg)
+            nt = self._sample(logits, subkey, self.cfg.temperature)
+            return (cache, lengths + 1, nt), nt
+
+        keys = jax.random.split(key, k)
+        (cache, lengths, tokens), toks = jax.lax.scan(
+            body, (cache, lengths, tokens), keys)
+        return toks, cache, lengths, tokens
 
     # -- host-side API --------------------------------------------------- #
 
@@ -165,6 +190,17 @@ class Engine:
         self._step_count += 1
         return np.asarray(jax.device_get(next_tokens))
 
+    def decode_many(self, k: int) -> np.ndarray:
+        """k fused decode steps; returns [k, B] tokens (one dispatch)."""
+        if k <= 1:
+            return self.decode()[None, :]
+        self._key, sub = jax.random.split(self._key)
+        toks, self._cache, self._lengths, self._tokens = \
+            self._decode_many_jit(self.params, self._cache, self._lengths,
+                                  self._tokens, sub, k=k)
+        self._step_count += k
+        return np.asarray(jax.device_get(toks))
+
     # -- continuous batching --------------------------------------------- #
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
@@ -188,12 +224,28 @@ class Engine:
                 self._finish_if_done(slots, slot_id, results)
             if not slots:
                 continue
-            tokens = self.decode()
-            for slot_id in list(slots):
-                slot = slots[slot_id]
-                tok = int(tokens[slot_id])
-                slot.tokens.append(tok)
-                self._finish_if_done(slots, slot_id, results)
+            # Chunked decode: fuse decode_chunk steps in one device
+            # program. k is ALWAYS 1 or decode_chunk (a variable k would
+            # compile one executable per distinct value); a slot
+            # finishing mid-chunk (max_new or EOS) just has its leftover
+            # chunk tokens dropped host-side, and pending requests are
+            # admitted on chunk boundaries — up to chunk-1 wasted
+            # slot-steps per finish/refill, far cheaper than a per-token
+            # dispatch (admission timing cannot change outputs: each
+            # request's tokens depend only on its own cache row). Only
+            # hard cache headroom forces k back to 1 near a row's end.
+            headroom = min(
+                self.cfg.max_decode_len - 1
+                - slot.prompt_len - len(slot.tokens)
+                for slot in slots.values())
+            k = (self.cfg.decode_chunk
+                 if headroom >= self.cfg.decode_chunk else 1)
+            chunk = self.decode_many(k)
+            for step in range(k):
+                for slot_id in list(slots):
+                    slot = slots[slot_id]
+                    slot.tokens.append(int(chunk[step, slot_id]))
+                    self._finish_if_done(slots, slot_id, results)
         return [results[i] for i in range(len(prompts))]
 
     def _finish_if_done(self, slots: Dict[int, _Slot], slot_id: int,

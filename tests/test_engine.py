@@ -121,3 +121,52 @@ def test_online_loop_streams_tokens(model):
     req_q.put(None)
     t.join(timeout=10)
     assert toks == _ref_greedy(params, cfg, prompt, 5)
+
+
+def test_chunked_decode_matches_single_step():
+    """generate_batch's fused decode_chunk path must produce exactly the
+    single-step greedy tokens (same params/seed, temperature 0)."""
+    import jax.numpy as jnp_
+    from skypilot_tpu.models import llama as llama_
+    from skypilot_tpu.serve import engine as engine_lib
+    cfg = llama_.LlamaConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=2, n_kv_heads=1,
+        ffn_dim=64, max_seq_len=128, dtype=jnp_.float32, remat=False,
+        use_flash_attention=False)
+    prompts = [[3, 5, 7], [11, 13], [2] * 10, [40, 41, 42, 43]]
+
+    def run(chunk):
+        eng = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=2, max_decode_len=64, prefill_buckets=(16,),
+                decode_chunk=chunk), seed=7)
+        return eng.generate_batch(prompts, max_new_tokens=13)
+
+    assert run(4) == run(1)
+
+
+def test_chunked_decode_respects_eos():
+    """A slot hitting EOS mid-chunk stops there; remaining chunk tokens
+    are dropped and the freed slot is reused."""
+    import jax.numpy as jnp_
+    from skypilot_tpu.models import llama as llama_
+    from skypilot_tpu.serve import engine as engine_lib
+    cfg = llama_.LlamaConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=1,
+        ffn_dim=64, max_seq_len=128, dtype=jnp_.float32, remat=False,
+        use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=1, max_decode_len=64, prefill_buckets=(16,),
+            decode_chunk=8), seed=3)
+    # Find whatever token the greedy model emits second, then make THAT
+    # the EOS: output must truncate before it deterministically.
+    [probe] = eng.generate_batch([[5, 9]], max_new_tokens=6)
+    assert len(probe) == 6
+    eos = probe[1]
+    eng2 = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=1, max_decode_len=64, prefill_buckets=(16,),
+            decode_chunk=8, eos_id=eos), seed=3)
+    [out] = eng2.generate_batch([[5, 9]], max_new_tokens=6)
+    assert out == probe[:1]
