@@ -107,7 +107,6 @@ def _serving_throughput(device):
     analog of the reference's JetStream numbers (BASELINE config 3:
     Llama-2-7B on v6e, ~2148 output tok/s). Best-effort: a failure here
     must never sink the training metric."""
-    import time as time_lib
     try:
         from skypilot_tpu.models import llama
         from skypilot_tpu.serve import engine as engine_lib
@@ -119,9 +118,9 @@ def _serving_throughput(device):
                 decode_chunk=32))   # offline: throughput over latency
         prompts = [[1] * 32 for _ in range(16)]
         eng.generate_batch(prompts, max_new_tokens=8)   # warmup/compile
-        t0 = time_lib.perf_counter()
+        t0 = time.perf_counter()
         out = eng.generate_batch(prompts, max_new_tokens=128)
-        dt = time_lib.perf_counter() - t0
+        dt = time.perf_counter() - t0
         tokens = sum(len(o) for o in out)
         return {
             'model': 'llama3-1b',

@@ -402,10 +402,12 @@ def _write_slot(cache: jax.Array, new: jax.Array,
     return jax.vmap(one)(cache, new, lengths)
 
 
-def decode_step(params: Params, cache: Params, lengths: jax.Array,
-                tokens: jax.Array, cfg: LlamaConfig):
-    """One token for every slot. tokens [B] int32, lengths [B] = #tokens
-    already cached per slot. Returns (logits [B, V] fp32, new_cache)."""
+def decode_tail(params: Params, cache: Params, lengths: jax.Array,
+                tokens: jax.Array, cfg: LlamaConfig, layer_body):
+    """Shared decode-step skeleton (Llama + the MoE models): embed the
+    new token, scan `layer_body` over (stacked layers, per-layer cache),
+    final-norm + lm_head. `layer_body(x, layer_params, angles,
+    (k_cache, v_cache, lengths))` returns (x, (k_cache, v_cache))."""
     angles = jax.vmap(
         lambda p: rope_frequencies(cfg, p[None]))(lengths)    # [B,1,half]
 
@@ -413,10 +415,8 @@ def decode_step(params: Params, cache: Params, lengths: jax.Array,
 
     def body(carry, xs):
         layer_params, k_cache, v_cache = xs
-        x, (k_cache, v_cache) = _layer(
-            cfg, carry, layer_params, angles,
-            cache=(k_cache, v_cache, lengths))
-        return x, (k_cache, v_cache)
+        return layer_body(carry, layer_params, angles,
+                          (k_cache, v_cache, lengths))
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params['layers'], cache['k'], cache['v']))
@@ -424,3 +424,13 @@ def decode_step(params: Params, cache: Params, lengths: jax.Array,
     logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
     return logits[:, 0], {'k': new_k, 'v': new_v}
+
+
+def decode_step(params: Params, cache: Params, lengths: jax.Array,
+                tokens: jax.Array, cfg: LlamaConfig):
+    """One token for every slot. tokens [B] int32, lengths [B] = #tokens
+    already cached per slot. Returns (logits [B, V] fp32, new_cache)."""
+    def layer_body(x, layer_params, angles, cache_triple):
+        return _layer(cfg, x, layer_params, angles, cache=cache_triple)
+
+    return decode_tail(params, cache, lengths, tokens, cfg, layer_body)

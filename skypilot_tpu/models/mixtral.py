@@ -162,23 +162,45 @@ def param_shardings(cfg: MixtralConfig) -> Params:
 # Model --------------------------------------------------------------- #
 
 def _layer(cfg: MixtralConfig, x: jax.Array, layer_params: Params,
-           angles: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """One block: shared-attention + sparse-MoE FFN. Returns (x, aux)."""
-    x, _ = llama.attention_block(cfg._attn_cfg(), x, layer_params, angles)
+           angles: jax.Array, return_kv: bool = False, cache=None):
+    """One block: shared-attention + sparse-MoE FFN.
+
+    Returns (x, aux, kv_out); kv semantics follow llama._layer —
+    `cache=(k_cache, v_cache, lengths)` switches to the KV-cache decode
+    path, `return_kv` emits this layer's fresh k/v for prefill."""
+    x, kv_out = llama.attention_block(cfg._attn_cfg(), x, layer_params,
+                                      angles, return_kv=return_kv,
+                                      cache=cache)
 
     mlp_in = llama.rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
+    # Serving paths (cached decode AND return_kv prefill) pin a drop-free
+    # capacity: decode so a request's output cannot depend on which other
+    # slots share its batch (the invariant the engine's admission logic
+    # relies on), prefill so bucket-padding tokens cannot evict a real
+    # token from an expert and logits stay bucket-size-independent.
+    # Training keeps the GShard capacity-factor semantics (drops ride
+    # the residual).
+    serving = cache is not None or return_kv
+    n_tokens = x.shape[0] * x.shape[1]
+    capacity = moe.drop_free_capacity(n_tokens) if serving else None
     moe_out, aux = moe.sparse_moe(
         mlp_in, layer_params['w_router'], layer_params['w_gate'],
-        layer_params['w_up'], layer_params['w_down'], cfg.moe)
+        layer_params['w_up'], layer_params['w_down'], cfg.moe,
+        capacity=capacity)
     x = x + moe_out
     x = llama._shard(x, llama.ACT_SPEC)
-    return x, aux
+    return x, aux, kv_out
 
 
 def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
-            positions: Optional[jax.Array] = None
-            ) -> Tuple[jax.Array, jax.Array]:
-    """tokens [B, S] int32 -> (logits [B, S, V] fp32, aux loss scalar)."""
+            positions: Optional[jax.Array] = None,
+            return_kv: bool = False):
+    """tokens [B, S] int32 -> (logits [B, S, V] fp32, aux loss scalar).
+
+    With return_kv=True (serving prefill) returns (logits, kv_dict)
+    instead — the aux loss is a training-only quantity, and this matches
+    llama.forward's serving contract so serve/engine.py can drive either
+    model family."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.arange(s)
@@ -186,29 +208,75 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
     x = params['embed'][tokens].astype(cfg.dtype)
     x = llama._shard(x, llama.ACT_SPEC)
 
-    layer_fn = functools.partial(_layer, cfg)
-    if cfg.remat:
+    layer_fn = functools.partial(_layer, cfg, return_kv=return_kv)
+    if cfg.remat and not return_kv:
         layer_fn = jax.checkpoint(
             layer_fn,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
 
+    kv = None
     if cfg.scan_layers:
         def scan_body(carry, layer_params):
-            return layer_fn(carry, layer_params, angles)
-        x, aux_per_layer = jax.lax.scan(scan_body, x, params['layers'])
+            x, aux, layer_kv = layer_fn(carry, layer_params, angles)
+            return x, ((aux, layer_kv) if return_kv else aux)
+        x, ys = jax.lax.scan(scan_body, x, params['layers'])
+        if return_kv:
+            aux_per_layer, kv = ys
+        else:
+            aux_per_layer = ys
         aux = jnp.sum(aux_per_layer)
     else:
         aux = jnp.zeros((), jnp.float32)
+        ks, vs = [], []
         for i in range(cfg.n_layers):
             layer_params = jax.tree.map(lambda p: p[i], params['layers'])
-            x, layer_aux = layer_fn(x, layer_params, angles)
+            x, layer_aux, layer_kv = layer_fn(x, layer_params, angles)
             aux = aux + layer_aux
+            if return_kv:
+                ks.append(layer_kv[0])
+                vs.append(layer_kv[1])
+        if return_kv:
+            kv = (jnp.stack(ks), jnp.stack(vs))
 
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
                         preferred_element_type=jnp.float32)
     logits = llama._shard(logits, llama.LOGITS_SPEC)
+    if return_kv:
+        return logits, {'k': kv[0], 'v': kv[1]}
     return logits, aux
+
+
+# Decode path (KV cache) ---------------------------------------------- #
+#
+# Serving counterpart for MoE models: the reference serves Mixtral only by
+# shelling out to vLLM (reference llm/mixtral/serve.yaml:40); here the
+# cached decode step is in-framework so serve/engine.py's continuous
+# batching drives Mixtral exactly like Llama. The KV cache layout is the
+# attention path's (llama.init_kv_cache); the MoE FFN has no cache state.
+
+def init_kv_cache(cfg: MixtralConfig, batch_size: int,
+                  max_len: int) -> Params:
+    return llama.init_kv_cache(cfg._attn_cfg(), batch_size, max_len)
+
+
+def decode_step(params: Params, cache: Params, lengths: jax.Array,
+                tokens: jax.Array, cfg: MixtralConfig):
+    """One token for every slot; llama.decode_tail with the sparse-MoE
+    FFN in the layer body. Returns (logits [B, V], new_cache).
+
+    The layer body pins capacity >= tokens for the cache path (see
+    _layer), so a decode step NEVER capacity-drops a token and a
+    request's outputs cannot depend on which other slots share its
+    batch — unlike a long prefill/training batch, where over-subscribed
+    experts drop tokens to the residual by design."""
+    def layer_body(x, layer_params, angles, cache_triple):
+        x, _aux, kv = _layer(cfg, x, layer_params, angles,
+                             cache=cache_triple)
+        return x, kv
+
+    return llama.decode_tail(params, cache, lengths, tokens,
+                             cfg._attn_cfg(), layer_body)
 
 
 def make_loss_fn(cfg: MixtralConfig):

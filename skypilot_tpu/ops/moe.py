@@ -37,11 +37,24 @@ class MoEConfig:
     router_z_weight: float = 1e-3
 
 
+def _tile8(n: int) -> int:
+    """Round up to a multiple of 8 (sublane) so the expert batch tiles."""
+    return max(8, -(-n // 8) * 8)
+
+
 def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
     cap = int(cfg.top_k * num_tokens * cfg.capacity_factor
               / cfg.num_experts) + 1
-    # Round up to a multiple of 8 (sublane) so the expert batch tiles.
-    return max(8, -(-cap // 8) * 8)
+    return _tile8(cap)
+
+
+def drop_free_capacity(num_tokens: int) -> int:
+    """Capacity >= num_tokens: a token's top-k experts are distinct, so an
+    expert can receive at most one slot request per token and no token is
+    ever capacity-dropped. The serving paths use this so a request's
+    output is a pure function of its own tokens (independent of padding,
+    bucket size, and co-batched slots)."""
+    return _tile8(num_tokens)
 
 
 def _top_k_dispatch(probs: jax.Array, cfg: MoEConfig, capacity: int
@@ -107,12 +120,16 @@ def sparse_moe(x: jax.Array,
                w_up: jax.Array,
                w_down: jax.Array,
                cfg: MoEConfig,
-               rng: Optional[jax.Array] = None
+               rng: Optional[jax.Array] = None,
+               capacity: Optional[int] = None
                ) -> Tuple[jax.Array, jax.Array]:
     """MoE SwiGLU FFN. x [B, S, D]; w_router [D, E]; experts [E, D, F] /
     [E, F, D]. Returns (out [B, S, D], weighted aux loss scalar).
 
     `rng`, when given, adds Switch-style input jitter during training.
+    `capacity` overrides the expert_capacity formula; since a token's
+    top-k experts are distinct, capacity >= num_tokens guarantees no
+    token is ever dropped (the serving decode path uses this).
     """
     b, s, d = x.shape
     x_flat = x.reshape(b * s, d)
@@ -125,7 +142,8 @@ def sparse_moe(x: jax.Array,
     router_logits = router_in @ w_router.astype(jnp.float32)   # [T, E]
     probs = jax.nn.softmax(router_logits, axis=-1)
 
-    capacity = expert_capacity(cfg, b * s)
+    if capacity is None:
+        capacity = expert_capacity(cfg, b * s)
     dispatch, combine, assigned = _top_k_dispatch(probs, cfg, capacity)
     dispatch = _shard(dispatch, DISPATCH_SPEC)
     combine = _shard(combine, DISPATCH_SPEC)

@@ -16,8 +16,9 @@ first-class component, JetStream-shaped but in-repo:
 
 The host-side loop (`Engine.run_loop` / `generate_batch`) owns slot
 assignment: requests queue up, finished slots are refilled without
-draining the batch. Per step exactly one small device->host transfer
-(the [B] token vector) happens.
+draining the batch. The online loop does one small device->host transfer
+(the [B] token vector) per step; the offline path fuses `decode_chunk`
+steps into one device program and transfers [k, B] tokens per dispatch.
 """
 from __future__ import annotations
 
@@ -64,12 +65,19 @@ class _Slot:
 
 
 class Engine:
-    """Batched decode engine over one model + one KV cache."""
+    """Batched decode engine over one model + one KV cache.
 
-    def __init__(self, model_cfg: llama.LlamaConfig,
+    `model` is a model module exposing the serving contract
+    (init_params, init_kv_cache, forward(..., return_kv=True) ->
+    (logits, kv), decode_step) — models/llama.py by default;
+    models/mixtral.py implements the same contract for MoE serving."""
+
+    def __init__(self, model_cfg: Any,
                  params: Optional[llama.Params] = None,
                  engine_cfg: Optional[EngineConfig] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 model: Any = None):
+        self.model = model if model is not None else llama
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
         # A prefill bucket longer than the cache could not be inserted;
@@ -78,10 +86,11 @@ class Engine:
             {min(b, self.cfg.max_decode_len - 1)
              for b in self.cfg.prefill_buckets}))
         if params is None:
-            params = llama.init_params(jax.random.PRNGKey(seed), model_cfg)
+            params = self.model.init_params(jax.random.PRNGKey(seed),
+                                            model_cfg)
         self.params = params
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
-        self._cache = llama.init_kv_cache(model_cfg, b, t)
+        self._cache = self.model.init_kv_cache(model_cfg, b, t)
         self._lengths = jnp.zeros((b,), jnp.int32)
         self._tokens = jnp.zeros((b,), jnp.int32)
         self._key = jax.random.PRNGKey(seed + 1)
@@ -110,7 +119,8 @@ class Engine:
 
     def _prefill_impl(self, params, tokens, true_len, key, cfg):
         """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
-        logits, kv = llama.forward(params, tokens, cfg, return_kv=True)
+        logits, kv = self.model.forward(params, tokens, cfg,
+                                        return_kv=True)
         last = logits[0, true_len - 1]
         tok = self._sample(last[None], key, self.cfg.temperature)[0]
         return tok, kv
@@ -130,8 +140,8 @@ class Engine:
         return new_cache, lengths, tokens
 
     def _decode_impl(self, params, cache, lengths, tokens, key, cfg):
-        logits, new_cache = llama.decode_step(params, cache, lengths,
-                                              tokens, cfg)
+        logits, new_cache = self.model.decode_step(params, cache,
+                                                   lengths, tokens, cfg)
         next_tokens = self._sample(logits, key, self.cfg.temperature)
         return next_tokens, new_cache, lengths + 1
 
@@ -141,8 +151,8 @@ class Engine:
         One dispatch + one host transfer per k tokens."""
         def body(carry, subkey):
             cache, lengths, tokens = carry
-            logits, cache = llama.decode_step(params, cache, lengths,
-                                              tokens, cfg)
+            logits, cache = self.model.decode_step(params, cache,
+                                                   lengths, tokens, cfg)
             nt = self._sample(logits, subkey, self.cfg.temperature)
             return (cache, lengths + 1, nt), nt
 

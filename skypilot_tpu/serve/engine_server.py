@@ -33,6 +33,7 @@ import jax
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import mixtral
 from skypilot_tpu.serve import engine as engine_lib
 
 logger = sky_logging.init_logger(__name__)
@@ -51,10 +52,14 @@ def decode_tokens(tokens: List[int]) -> str:
     return data.decode('utf-8', errors='replace')
 
 
+# name -> (config factory, model module implementing the serving
+# contract — see serve/engine.py Engine docstring).
 MODEL_PRESETS = {
-    'tiny': llama.llama_tiny,
-    'llama3-1b': llama.llama3_1b,
-    'llama3-8b': llama.llama3_8b,
+    'tiny': (llama.llama_tiny, llama),
+    'llama3-1b': (llama.llama3_1b, llama),
+    'llama3-8b': (llama.llama3_8b, llama),
+    'mixtral-tiny': (mixtral.mixtral_tiny, mixtral),
+    'mixtral-8x7b': (mixtral.mixtral_8x7b, mixtral),
 }
 
 
@@ -63,10 +68,12 @@ class ModelServer:
     def __init__(self, model: str = 'tiny', port: int = 8000,
                  batch_size: int = 8, max_decode_len: int = 1024,
                  temperature: float = 0.0):
-        cfg = MODEL_PRESETS[model]()
+        cfg_factory, model_module = MODEL_PRESETS[model]
+        cfg = cfg_factory()
         # Byte-level vocab must fit.
         self.engine = engine_lib.Engine(
-            cfg, engine_cfg=engine_lib.EngineConfig(
+            cfg, model=model_module,
+            engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
                 eos_id=EOS_ID, temperature=temperature))
         self.port = port

@@ -170,3 +170,103 @@ def test_chunked_decode_respects_eos():
             decode_chunk=8, eos_id=eos), seed=3)
     [out] = eng2.generate_batch([[5, 9]], max_new_tokens=6)
     assert out == probe[:1]
+
+
+# Mixtral (MoE) serving path ------------------------------------------- #
+
+def _mixtral_test_cfg():
+    from skypilot_tpu.models import mixtral as mixtral_
+    # fp32 so argmax ties can't flake between the cached and full paths.
+    # capacity_factor=2.0 makes expert capacity >= tokens, so the
+    # full-forward reference can never capacity-drop a token: per-token
+    # decode has no expert contention (B tokens/step), so drops in the
+    # uncached path would be a legitimate, not-a-bug divergence.
+    return mixtral_.MixtralConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, num_experts=4, top_k=2, capacity_factor=2.0,
+        max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,
+        remat=False, use_flash_attention=False)
+
+
+def _mixtral_ref_greedy(params, cfg, prompt, n):
+    from skypilot_tpu.models import mixtral as mixtral_
+    toks = list(prompt)
+    out = []
+    for _ in range(n):
+        logits, _aux = mixtral_.forward(params, jnp.asarray([toks]), cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+@pytest.fixture(scope='module')
+def mixtral_model():
+    from skypilot_tpu.models import mixtral as mixtral_
+    cfg = _mixtral_test_cfg()
+    params = mixtral_.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_mixtral_decode_matches_full_forward(mixtral_model):
+    """Cached MoE decode through the engine == rerunning the full
+    (uncached) mixtral forward on the growing sequence. Routing happens
+    per token, so this also pins the decode path's router behavior."""
+    from skypilot_tpu.models import mixtral as mixtral_
+    cfg, params = mixtral_model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8, 16)),
+        model=mixtral_)
+    prompt = [3, 17, 99, 42, 7]
+    [got] = eng.generate_batch([prompt], max_new_tokens=8)
+    want = _mixtral_ref_greedy(params, cfg, prompt, 8)
+    assert got == want
+
+
+def test_mixtral_continuous_batching(mixtral_model):
+    from skypilot_tpu.models import mixtral as mixtral_
+    cfg, params = mixtral_model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8, 16), decode_chunk=4),
+        model=mixtral_)
+    rng = np.random.RandomState(2)
+    prompts = [list(rng.randint(1, 127, size=rng.randint(2, 9)))
+               for _ in range(4)]
+    prompts = [[int(t) for t in p] for p in prompts]
+    got = eng.generate_batch(prompts, max_new_tokens=6)
+    for p, g in zip(prompts, got):
+        assert g == _mixtral_ref_greedy(params, cfg, p, 6), f'prompt {p}'
+
+
+def test_mixtral_prefill_bucket_independent():
+    """Serving prefill pins a drop-free expert capacity, so bucket
+    padding can never evict a real token from an expert: the same prompt
+    must produce identical outputs regardless of prefill bucket size,
+    and match the uncached full-forward greedy — even with the default
+    tight capacity_factor where the padded bucket would otherwise
+    capacity-drop real tokens."""
+    from skypilot_tpu.models import mixtral as mixtral_
+    cfg = mixtral_.MixtralConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, num_experts=8, top_k=2, capacity_factor=1.25,
+        max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,
+        remat=False, use_flash_attention=False)
+    params = mixtral_.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = [3, 17, 99, 42, 7, 11, 88, 54, 23]     # 9 real tokens
+
+    def run(buckets):
+        eng = engine_lib.Engine(
+            cfg, params,
+            engine_lib.EngineConfig(batch_size=1, max_decode_len=64,
+                                    prefill_buckets=buckets),
+            model=mixtral_)
+        [out] = eng.generate_batch([prompt], max_new_tokens=5)
+        return out
+
+    small, big = run((10,)), run((16,))
+    assert small == big
+    assert small == _mixtral_ref_greedy(params, cfg, prompt, 5)
