@@ -111,20 +111,21 @@ def _serving_throughput(device):
         from skypilot_tpu.models import llama
         from skypilot_tpu.serve import engine as engine_lib
         cfg = llama.llama3_1b()
+        batch = 32
         eng = engine_lib.Engine(
             cfg, engine_cfg=engine_lib.EngineConfig(
-                batch_size=16, max_decode_len=256,
+                batch_size=batch, max_decode_len=512,
                 prefill_buckets=(64,),
-                decode_chunk=32))   # offline: throughput over latency
-        prompts = [[1] * 32 for _ in range(16)]
+                decode_chunk=64))   # offline: throughput over latency
+        prompts = [[1] * 32 for _ in range(batch)]
         eng.generate_batch(prompts, max_new_tokens=8)   # warmup/compile
         t0 = time.perf_counter()
-        out = eng.generate_batch(prompts, max_new_tokens=128)
+        out = eng.generate_batch(prompts, max_new_tokens=256)
         dt = time.perf_counter() - t0
         tokens = sum(len(o) for o in out)
         return {
             'model': 'llama3-1b',
-            'batch_size': 16,
+            'batch_size': batch,
             'output_tok_per_s': round(tokens / dt, 1),
             'measured_on': device.device_kind,
         }

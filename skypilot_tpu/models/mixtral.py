@@ -180,13 +180,32 @@ def _layer(cfg: MixtralConfig, x: jax.Array, layer_params: Params,
     # token from an expert and logits stay bucket-size-independent.
     # Training keeps the GShard capacity-factor semantics (drops ride
     # the residual).
-    serving = cache is not None or return_kv
-    n_tokens = x.shape[0] * x.shape[1]
-    capacity = moe.drop_free_capacity(n_tokens) if serving else None
-    moe_out, aux = moe.sparse_moe(
-        mlp_in, layer_params['w_router'], layer_params['w_gate'],
-        layer_params['w_up'], layer_params['w_down'], cfg.moe,
-        capacity=capacity)
+    if return_kv and x.shape[0] > 1:
+        # Batched prefill: route each request's tokens independently
+        # (vmap over rows). Joint routing would need a drop-free
+        # capacity over ALL N*S wave tokens, making the [T, E, C]
+        # dispatch buffers quadratic in wave tokens (OOM territory for
+        # long buckets); per-row routing keeps them linear in N and is
+        # exactly the per-request independence the engine relies on.
+        cap = moe.drop_free_capacity(x.shape[1])
+
+        def one_row(row):
+            out, row_aux = moe.sparse_moe(
+                row[None], layer_params['w_router'],
+                layer_params['w_gate'], layer_params['w_up'],
+                layer_params['w_down'], cfg.moe, capacity=cap)
+            return out[0], row_aux
+
+        moe_out, aux = jax.vmap(one_row)(mlp_in)
+        aux = jnp.sum(aux)
+    else:
+        serving = cache is not None or return_kv
+        n_tokens = x.shape[0] * x.shape[1]
+        capacity = moe.drop_free_capacity(n_tokens) if serving else None
+        moe_out, aux = moe.sparse_moe(
+            mlp_in, layer_params['w_router'], layer_params['w_gate'],
+            layer_params['w_up'], layer_params['w_down'], cfg.moe,
+            capacity=capacity)
     x = x + moe_out
     x = llama._shard(x, llama.ACT_SPEC)
     return x, aux, kv_out

@@ -99,7 +99,11 @@ class Engine:
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
             static_argnames=())
+        self._prefill_many_jit = jax.jit(
+            functools.partial(self._prefill_many_impl, cfg=model_cfg))
         self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._insert_many_jit = jax.jit(self._insert_many_impl,
+                                        donate_argnums=(0,))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
             donate_argnums=(1,))
@@ -139,6 +143,32 @@ class Engine:
         tokens = tokens.at[slot].set(first_token)
         return new_cache, lengths, tokens
 
+    def _prefill_many_impl(self, params, tokens, true_lens, key, cfg):
+        """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
+        Returns (first_tokens [N], kv [L, N, S, KV, hd]). Rows are
+        independent (causal attention; the MoE path pins a drop-free
+        capacity under return_kv, see models/mixtral.py), so batching
+        prompts cannot change any prompt's output."""
+        logits, kv = self.model.forward(params, tokens, cfg,
+                                        return_kv=True)
+        last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
+        toks = self._sample(last, key, self.cfg.temperature)
+        return toks, kv
+
+    def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
+                          lengths, tokens, first_tokens):
+        """Scatter prefix kv [L,N,S,KV,hd] into cache rows `slots` [N]
+        (distinct), one device program for the whole wave."""
+        s = prefix_kv['k'].shape[2]
+        new_cache = {}
+        for name in ('k', 'v'):
+            dst = cache[name]                          # [L,B,T,KV,hd]
+            new_cache[name] = dst.at[:, slots, :s].set(
+                prefix_kv[name].astype(dst.dtype))
+        lengths = lengths.at[slots].set(lengths_new)
+        tokens = tokens.at[slots].set(first_tokens)
+        return new_cache, lengths, tokens
+
     def _decode_impl(self, params, cache, lengths, tokens, key, cfg):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
@@ -171,12 +201,25 @@ class Engine:
             f'prompt length {n} exceeds largest prefill bucket '
             f'{self._buckets[-1]}')
 
-    def prefill(self, prompt: Sequence[int]) -> Tuple[int, Any]:
-        """Returns (first generated token, prefix kv) for one prompt."""
+    def _validate(self, prompt: Sequence[int]) -> None:
+        """Raise ValueError for any prompt the engine cannot serve; the
+        single source of truth for request validation (prefill, admit,
+        and the loops all route through it)."""
         if not prompt:
             raise ValueError('empty prompt')
         if len(prompt) >= self.cfg.max_decode_len:
             raise ValueError('prompt longer than max_decode_len')
+        self._bucket(len(prompt))
+        try:
+            arr = np.asarray(prompt, dtype=np.int32)
+        except (ValueError, TypeError) as e:
+            raise ValueError(f'prompt must be a flat int sequence: {e}')
+        if arr.ndim != 1:
+            raise ValueError('prompt must be a flat int sequence')
+
+    def prefill(self, prompt: Sequence[int]) -> Tuple[int, Any]:
+        """Returns (first generated token, prefix kv) for one prompt."""
+        self._validate(prompt)
         bucket = self._bucket(len(prompt))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(prompt)] = prompt
@@ -190,6 +233,62 @@ class Engine:
         self._cache, self._lengths, self._tokens = self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
             self._tokens, first_token)
+
+    # Cap on one batched-prefill dispatch: bounds the transient
+    # [L, N, S, KV, hd] prefill-kv buffer and the number of distinct
+    # (bucket, N) executables (N is a power of two <= this).
+    _MAX_PREFILL_GROUP = 16
+
+    def admit(self, assignments: Sequence[Tuple[int, Sequence[int]]]
+              ) -> Dict[int, int]:
+        """Prefill + insert a wave of (slot_id, prompt) pairs; returns
+        {slot_id: first_token}. Same-bucket prompts are grouped into
+        power-of-two batched prefills — one forward + one cache scatter
+        per group instead of two dispatches per prompt, which is what
+        dominates wall-clock when many requests arrive at once (each
+        dispatch is a host round trip). Validates every prompt up front
+        and raises BEFORE touching any engine state, so a bad prompt in
+        a wave cannot leave a partially-admitted batch behind."""
+        for _slot_id, prompt in assignments:
+            self._validate(prompt)
+        out: Dict[int, int] = {}
+        by_bucket: Dict[int, List[Tuple[int, Sequence[int]]]] = {}
+        for slot_id, prompt in assignments:
+            by_bucket.setdefault(self._bucket(len(prompt)), []).append(
+                (slot_id, prompt))
+        for bucket, group in by_bucket.items():
+            i = 0
+            while i < len(group):
+                rest = len(group) - i
+                n = min(1 << (rest.bit_length() - 1),
+                        self._MAX_PREFILL_GROUP)
+                chunk = group[i:i + n]
+                i += n
+                if n == 1:
+                    slot_id, prompt = chunk[0]
+                    first, kv = self.prefill(prompt)
+                    self.insert(kv, slot_id, len(prompt), first)
+                    out[slot_id] = first
+                    continue
+                padded = np.zeros((n, bucket), np.int32)
+                for j, (_sid, p) in enumerate(chunk):
+                    padded[j, :len(p)] = p
+                true_lens = np.array([len(p) for _s, p in chunk],
+                                     np.int32)
+                slots = np.array([s for s, _p in chunk], np.int32)
+                self._key, sub = jax.random.split(self._key)
+                toks, kv = self._prefill_many_jit(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray(true_lens), sub)
+                self._cache, self._lengths, self._tokens = \
+                    self._insert_many_jit(
+                        self._cache, kv, jnp.asarray(slots),
+                        jnp.asarray(true_lens), self._lengths,
+                        self._tokens, toks)
+                toks_np = np.asarray(jax.device_get(toks))
+                for j, (sid, _p) in enumerate(chunk):
+                    out[sid] = int(toks_np[j])
+        return out
 
     def decode(self) -> np.ndarray:
         """One decode step for every slot; returns the [B] token vector."""
@@ -224,14 +323,20 @@ class Engine:
         while pending or slots:
             free = [s for s in range(self.cfg.batch_size)
                     if s not in slots]
+            wave: List[Tuple[int, Sequence[int]]] = []
+            meta: Dict[int, int] = {}
             while pending and free:
                 req_id, prompt = pending.pop()
                 slot_id = free.pop(0)
-                first, kv = self.prefill(prompt)
-                self.insert(kv, slot_id, len(prompt), first)
-                slots[slot_id] = _Slot(req_id, len(prompt), [first],
-                                       max_new_tokens)
-                self._finish_if_done(slots, slot_id, results)
+                wave.append((slot_id, prompt))
+                meta[slot_id] = req_id
+            if wave:
+                firsts = self.admit(wave)
+                for slot_id, prompt in wave:
+                    slots[slot_id] = _Slot(meta[slot_id], len(prompt),
+                                           [firsts[slot_id]],
+                                           max_new_tokens)
+                    self._finish_if_done(slots, slot_id, results)
             if not slots:
                 continue
             # Chunked decode: fuse decode_chunk steps in one device
@@ -302,30 +407,53 @@ class Engine:
                 pass
             if stop.is_set():
                 break
-            # Admit in arrival order while slots are free. A bad request
-            # must not kill the loop: report it and move on.
-            while waiting:
-                free = [s for s in range(self.cfg.batch_size)
-                        if s not in slots]
-                if not free:
-                    break
+            # Admit in arrival order while slots are free; a burst of
+            # waiting requests rides batched prefill (admit groups
+            # same-bucket prompts into one dispatch). A bad request must
+            # not kill the loop: validate up front, report it, move on.
+            free = [s for s in range(self.cfg.batch_size)
+                    if s not in slots]
+            wave = []
+            meta = {}
+            while waiting and free:
                 prompt, max_new, out_q = waiting.popleft()
                 try:
-                    first, kv = self.prefill(prompt)
+                    self._validate(prompt)
                 except Exception as e:  # noqa: BLE001
                     logger.warning('rejecting request: %s', e)
                     if out_q is not None:
                         out_q.put(e)
                         out_q.put(None)
                     continue
-                slot_id = free[0]
-                self.insert(kv, slot_id, len(prompt), first)
-                slots[slot_id] = _Slot(next_id, len(prompt), [first],
-                                       max_new, out_q)
-                next_id += 1
-                if not (self.cfg.eos_id >= 0 and first == self.cfg.eos_id):
-                    out_q.put(first)
-                self._finish_if_done(slots, slot_id, None)
+                slot_id = free.pop(0)
+                wave.append((slot_id, prompt))
+                meta[slot_id] = (max_new, out_q)
+            if wave:
+                try:
+                    firsts = self.admit(wave)
+                except Exception as e:  # noqa: BLE001
+                    # Defense in depth: admit validates up front, so this
+                    # is unexpected — but the serving loop must outlive
+                    # any single wave. Reject the wave's clients and
+                    # keep going.
+                    logger.warning('admit failed, rejecting wave: %s', e)
+                    for _slot_id, _prompt in wave:
+                        _mn, out_q = meta[_slot_id]
+                        if out_q is not None:
+                            out_q.put(e)
+                            out_q.put(None)
+                    continue
+                for slot_id, prompt in wave:
+                    first = firsts[slot_id]
+                    max_new, out_q = meta[slot_id]
+                    slots[slot_id] = _Slot(next_id, len(prompt), [first],
+                                           max_new, out_q)
+                    next_id += 1
+                    if out_q is not None and not (
+                            self.cfg.eos_id >= 0
+                            and first == self.cfg.eos_id):
+                        out_q.put(first)
+                    self._finish_if_done(slots, slot_id, None)
             if not slots:
                 continue
             tokens = self.decode()

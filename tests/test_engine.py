@@ -270,3 +270,66 @@ def test_mixtral_prefill_bucket_independent():
     small, big = run((10,)), run((16,))
     assert small == big
     assert small == _mixtral_ref_greedy(params, cfg, prompt, 5)
+
+
+def test_batched_prefill_wave_matches_reference(model):
+    """A wave bigger than the power-of-two group (5 prompts, mixed
+    buckets) goes through admit()'s batched prefill; outputs must be
+    identical to the per-prompt reference path."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=8, max_decode_len=64,
+                                prefill_buckets=(8, 16)))
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, 127, size=n))
+               for n in (3, 5, 8, 12, 16)]
+    prompts = [[int(t) for t in p] for p in prompts]
+    got = eng.generate_batch(prompts, max_new_tokens=5)
+    for p, g in zip(prompts, got):
+        assert g == _ref_greedy(params, cfg, p, 5), f'prompt {p}'
+
+
+def test_invalid_prompts_rejected_before_state_mutation(model):
+    """admit() validates the whole wave up front: an empty prompt in a
+    batched wave raises instead of silently sampling from a padding
+    position, and no partial admission happens."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=4, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    with pytest.raises(ValueError):
+        eng.generate_batch([[], [1, 2]], max_new_tokens=3)
+    assert int(np.sum(np.asarray(eng._lengths))) == 0  # nothing admitted
+
+
+def test_run_loop_survives_malformed_request(model):
+    """A request whose content is not a flat int sequence is rejected to
+    its own queue; the loop keeps serving later requests."""
+    import queue
+    import threading
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    req_q = queue.Queue()
+    stop = threading.Event()
+    t = threading.Thread(target=eng.run_loop, args=(req_q, stop),
+                         daemon=True)
+    t.start()
+    bad_q, good_q = queue.Queue(), queue.Queue()
+    req_q.put((['not', 'ints'], 3, bad_q))
+    req_q.put(([3, 17, 99], 3, good_q))
+    assert isinstance(bad_q.get(timeout=30), ValueError)
+    assert bad_q.get(timeout=5) is None
+    toks = []
+    while True:
+        item = good_q.get(timeout=30)
+        if item is None:
+            break
+        toks.append(item)
+    req_q.put(None)
+    t.join(timeout=10)
+    assert toks == _ref_greedy(params, cfg, [3, 17, 99], 3)
