@@ -110,25 +110,38 @@ def _serving_throughput(device):
     try:
         from skypilot_tpu.models import llama
         from skypilot_tpu.serve import engine as engine_lib
+        import gc
+
         cfg = llama.llama3_1b()
         batch = 32
-        eng = engine_lib.Engine(
-            cfg, engine_cfg=engine_lib.EngineConfig(
-                batch_size=batch, max_decode_len=512,
-                prefill_buckets=(64,),
-                decode_chunk=64))   # offline: throughput over latency
-        prompts = [[1] * 32 for _ in range(batch)]
-        eng.generate_batch(prompts, max_new_tokens=8)   # warmup/compile
-        t0 = time.perf_counter()
-        out = eng.generate_batch(prompts, max_new_tokens=256)
-        dt = time.perf_counter() - t0
-        tokens = sum(len(o) for o in out)
-        return {
+
+        def run(quantize):
+            eng = engine_lib.Engine(
+                cfg, engine_cfg=engine_lib.EngineConfig(
+                    batch_size=batch, max_decode_len=512,
+                    prefill_buckets=(64,), decode_chunk=64,
+                    quantize=quantize))  # offline: throughput > latency
+            prompts = [[1] * 32 for _ in range(batch)]
+            eng.generate_batch(prompts, max_new_tokens=8)  # compile
+            t0 = time.perf_counter()
+            out = eng.generate_batch(prompts, max_new_tokens=256)
+            dt = time.perf_counter() - t0
+            tokens = sum(len(o) for o in out)
+            del eng
+            gc.collect()
+            return round(tokens / dt, 1)
+
+        report = {
             'model': 'llama3-1b',
             'batch_size': batch,
-            'output_tok_per_s': round(tokens / dt, 1),
+            'output_tok_per_s': run(None),
             'measured_on': device.device_kind,
         }
+        try:
+            report['output_tok_per_s_int8'] = run('int8')
+        except Exception as e:  # noqa: BLE001 — optional sub-metric
+            report['int8_error'] = str(e)[:120]
+        return report
     except Exception as e:  # noqa: BLE001 — optional metric
         return {'error': str(e)[:200]}
 

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from skypilot_tpu.ops import quant
 from skypilot_tpu.parallel.mesh import shard as _shard
 
 Params = Dict[str, Any]
@@ -122,6 +123,22 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         },
         'final_norm': jnp.ones((d,), cfg.dtype),
         'lm_head': norm_init(keys[8], (v, d), d),
+    }
+
+
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 for serving (ops/quant.py): every matmul weight
+    gets a per-output-channel scale; norms stay dense. forward /
+    decode_step accept the result directly (all weight sites go through
+    quant.qdot / qeinsum / qtake). Training never uses this."""
+    layers = dict(params['layers'])
+    for name in ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'):
+        layers[name] = quant.quantize(layers[name], reduce_axes=(-2,))
+    return {
+        'embed': quant.quantize(params['embed'], reduce_axes=(-1,)),
+        'layers': layers,
+        'final_norm': params['final_norm'],
+        'lm_head': quant.quantize(params['lm_head'], reduce_axes=(-1,)),
     }
 
 
@@ -267,9 +284,9 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
                                 return_kv=return_kv, cache=cache)
 
     mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
-    gate = jax.nn.silu(mlp_in @ layer_params['w_gate'])
-    up = mlp_in @ layer_params['w_up']
-    x = x + (gate * up) @ layer_params['w_down']
+    gate = jax.nn.silu(quant.qdot(mlp_in, layer_params['w_gate']))
+    up = quant.qdot(mlp_in, layer_params['w_up'])
+    x = x + quant.qdot(gate * up, layer_params['w_down'])
     x = _shard(x, ACT_SPEC)
     return x, kv_out
 
@@ -284,9 +301,9 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     attn_in = rms_norm(x, layer_params['ln_attn'], cfg.norm_eps)
-    q = (attn_in @ layer_params['wq']).reshape(b, s, h, hd)
-    k = (attn_in @ layer_params['wk']).reshape(b, s, kv, hd)
-    v = (attn_in @ layer_params['wv']).reshape(b, s, kv, hd)
+    q = quant.qdot(attn_in, layer_params['wq']).reshape(b, s, h, hd)
+    k = quant.qdot(attn_in, layer_params['wk']).reshape(b, s, kv, hd)
+    v = quant.qdot(attn_in, layer_params['wv']).reshape(b, s, kv, hd)
     q = apply_rope(q, angles)
     k = apply_rope(k, angles)
     if cache is not None:
@@ -301,7 +318,7 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     else:
         attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
         kv_out = (k, v) if return_kv else None
-    x = x + attn_out @ layer_params['wo']
+    x = x + quant.qdot(attn_out, layer_params['wo'])
     return _shard(x, ACT_SPEC), kv_out
 
 
@@ -314,7 +331,7 @@ def forward(params: Params, tokens: jax.Array,
     if positions is None:
         positions = jnp.arange(s)
     angles = rope_frequencies(cfg, positions)
-    x = params['embed'][tokens].astype(cfg.dtype)
+    x = quant.qtake(params['embed'], tokens, cfg.dtype)
     x = _shard(x, ACT_SPEC)
 
     # Bind return_kv BEFORE any jax.checkpoint wrap: a bool passed through
@@ -342,8 +359,8 @@ def forward(params: Params, tokens: jax.Array,
             kv = (jnp.stack(ks), jnp.stack(vs))
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
+                           preferred_element_type=jnp.float32)
     logits = _shard(logits, LOGITS_SPEC)
     if return_kv:
         return logits, {'k': kv[0], 'v': kv[1]}
@@ -411,7 +428,7 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
     angles = jax.vmap(
         lambda p: rope_frequencies(cfg, p[None]))(lengths)    # [B,1,half]
 
-    x = params['embed'][tokens][:, None].astype(cfg.dtype)    # [B,1,D]
+    x = quant.qtake(params['embed'], tokens, cfg.dtype)[:, None]  # [B,1,D]
 
     def body(carry, xs):
         layer_params, k_cache, v_cache = xs
@@ -421,8 +438,8 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params['layers'], cache['k'], cache['v']))
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
+                           preferred_element_type=jnp.float32)
     return logits[:, 0], {'k': new_k, 'v': new_v}
 
 

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import moe
+from skypilot_tpu.ops import quant
 
 Params = Dict[str, Any]
 
@@ -224,7 +225,7 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
     if positions is None:
         positions = jnp.arange(s)
     angles = llama.rope_frequencies(cfg._attn_cfg(), positions)
-    x = params['embed'][tokens].astype(cfg.dtype)
+    x = quant.qtake(params['embed'], tokens, cfg.dtype)
     x = llama._shard(x, llama.ACT_SPEC)
 
     layer_fn = functools.partial(_layer, cfg, return_kv=return_kv)
@@ -258,8 +259,8 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
             kv = (jnp.stack(ks), jnp.stack(vs))
 
     x = llama.rms_norm(x, params['final_norm'], cfg.norm_eps)
-    logits = jnp.einsum('bsd,vd->bsv', x, params['lm_head'],
-                        preferred_element_type=jnp.float32)
+    logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
+                           preferred_element_type=jnp.float32)
     logits = llama._shard(logits, llama.LOGITS_SPEC)
     if return_kv:
         return logits, {'k': kv[0], 'v': kv[1]}
@@ -307,3 +308,19 @@ def make_loss_fn(cfg: MixtralConfig):
         logits, aux = forward(params, inputs, cfg)
         return trainer.cross_entropy_loss(logits, targets) + aux
     return loss_fn
+
+
+def quantize_params(params: Params) -> Params:
+    """Weight-only int8 for serving (ops/quant.py): attention mats,
+    per-expert FFN mats ([L, E, D, F] with per-(expert, out-channel)
+    scales), embed and lm_head. The fp32 router stays dense — it is
+    tiny and routing decisions are numerically sensitive."""
+    layers = dict(params['layers'])
+    for name in ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'):
+        layers[name] = quant.quantize(layers[name], reduce_axes=(-2,))
+    return {
+        'embed': quant.quantize(params['embed'], reduce_axes=(-1,)),
+        'layers': layers,
+        'final_norm': params['final_norm'],
+        'lm_head': quant.quantize(params['lm_head'], reduce_axes=(-1,)),
+    }

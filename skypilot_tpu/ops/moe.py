@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from skypilot_tpu.ops import quant
 from skypilot_tpu.parallel.mesh import shard as _shard
 
 
@@ -149,12 +150,17 @@ def sparse_moe(x: jax.Array,
     combine = _shard(combine, DISPATCH_SPEC)
 
     # Dispatch: [T, D] x [T, E, C] -> [E, C, D]; all-to-all over 'ep'.
-    xs = jnp.einsum('td,tec->ecd', x_flat.astype(w_gate.dtype),
-                    dispatch.astype(w_gate.dtype))
+    cdt = x.dtype
+    xs = jnp.einsum('td,tec->ecd', x_flat.astype(cdt),
+                    dispatch.astype(cdt))
     xs = _shard(xs, EXPERT_IN_SPEC)
-    gate = jax.nn.silu(jnp.einsum('ecd,edf->ecf', xs, w_gate))
-    up = jnp.einsum('ecd,edf->ecf', xs, w_up)
-    out_e = jnp.einsum('ecf,efd->ecd', gate * up, w_down)      # [E, C, D]
+    # Expert matmuls: weights may be int8 QTensors (weight-only serving
+    # quantization); scale [E, F] broadcasts over the capacity axis.
+    gate = jax.nn.silu(quant.qeinsum('ecd,edf->ecf', xs, w_gate,
+                                     scale_insert_axes=(1,)))
+    up = quant.qeinsum('ecd,edf->ecf', xs, w_up, scale_insert_axes=(1,))
+    out_e = quant.qeinsum('ecf,efd->ecd', gate * up, w_down,
+                          scale_insert_axes=(1,))              # [E, C, D]
     out = jnp.einsum('ecd,tec->td', out_e,
                      combine.astype(out_e.dtype))              # [T, D]
     out = _shard(out, TOKENS_SPEC)

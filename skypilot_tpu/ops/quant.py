@@ -1,0 +1,103 @@
+"""Weight-only int8 quantization for serving, TPU-first.
+
+Decode is weight-bound on TPU: every step streams the full parameter set
+from HBM while the MXU sits mostly idle, so halving the bytes per weight
+nearly halves the step time. The reference has no in-framework
+quantization (its serving story shells out to vLLM/JetStream recipes —
+reference llm/mixtral/serve.yaml, examples/tpu/v6e/README.md:104); here
+it is an engine flag.
+
+Scheme: symmetric per-output-channel int8. For w [.., D, F] with output
+axis F:  scale[f] = max_d |w[d, f]| / 127,  q = round(w / scale).
+The matmul computes (x @ q) * scale — the int8->bf16 convert fuses into
+the XLA matmul loop, so weights are READ from HBM as int8 (the point),
+and the per-channel rescale is one cheap elementwise multiply on the
+output. Mathematically identical to x @ (q * scale); floating-point
+rounding differs only at the ulp level.
+
+QTensor is a pytree node, so quantized layer stacks ride `lax.scan`
+(leading-axis slicing hits q and scale together) and jit boundaries
+unchanged.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 weights + per-output-channel scale (last axis of q)."""
+    q: jax.Array          # int8, same shape as the original weight
+    scale: jax.Array      # float32, shape = q.shape minus the reduced axes
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize(w: jax.Array, reduce_axes=(-2,)) -> QTensor:
+    """Symmetric int8 over `reduce_axes` (the contraction axes of the
+    matmul this weight feeds); remaining axes keep their own scale."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=reduce_axes,
+                   keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=jnp.squeeze(scale, axis=reduce_axes))
+
+
+def dequantize(w: QTensor, reduce_axes=(-2,),
+               dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Dense reconstruction (tests / fallback paths)."""
+    scale = jnp.expand_dims(w.scale, axis=reduce_axes)
+    return (w.q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def qdot(x: jax.Array, w: Any) -> jax.Array:
+    """x [..., D] @ w [D, F] where w is dense or a QTensor with
+    per-[F] scale."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
+    return x @ w
+
+
+def qeinsum(spec: str, x: jax.Array, w: Any, scale_insert_axes=None,
+            **kwargs) -> jax.Array:
+    """einsum where the weight operand may be a QTensor. The scale
+    multiplies the OUTPUT; when the weight's kept axes are not the
+    output's trailing axes, `scale_insert_axes` expand_dims the scale
+    into broadcast position."""
+    if isinstance(w, QTensor):
+        out = jnp.einsum(spec, x, w.q.astype(x.dtype), **kwargs)
+        scale = w.scale.astype(out.dtype)
+        if scale_insert_axes is not None:
+            scale = jnp.expand_dims(scale, scale_insert_axes)
+        return out * scale
+    return jnp.einsum(spec, x, w, **kwargs)
+
+
+def qtake(w: Any, idx: jax.Array, dtype: Any) -> jax.Array:
+    """Embedding gather where the table may be a QTensor quantized with
+    per-ROW scale (reduce_axes=(-1,)): gathers int8 rows + their scales
+    — the table lives in HBM at half size."""
+    if isinstance(w, QTensor):
+        return (w.q[idx].astype(dtype)
+                * w.scale[idx].astype(dtype)[..., None])
+    return w[idx].astype(dtype)

@@ -53,6 +53,11 @@ class EngineConfig:
     # decode; through remote-execution relays each sync is a network
     # round trip). The online run_loop stays at 1 for token latency.
     decode_chunk: int = 8
+    # Weight-only quantization ('int8' or None): decode streams the full
+    # parameter set from HBM every step, so int8 weights nearly halve
+    # the step time (ops/quant.py). Applied once at engine init via the
+    # model module's quantize_params.
+    quantize: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -88,6 +93,12 @@ class Engine:
         if params is None:
             params = self.model.init_params(jax.random.PRNGKey(seed),
                                             model_cfg)
+        if self.cfg.quantize is not None:
+            if self.cfg.quantize != 'int8':
+                raise ValueError(
+                    f'unsupported quantize mode {self.cfg.quantize!r} '
+                    "(only 'int8')")
+            params = self.model.quantize_params(params)
         self.params = params
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
         self._cache = self.model.init_kv_cache(model_cfg, b, t)
@@ -211,11 +222,15 @@ class Engine:
             raise ValueError('prompt longer than max_decode_len')
         self._bucket(len(prompt))
         try:
-            arr = np.asarray(prompt, dtype=np.int32)
-        except (ValueError, TypeError) as e:
+            arr = np.asarray(prompt)
+        except Exception as e:  # noqa: BLE001 — ragged/mixed content
             raise ValueError(f'prompt must be a flat int sequence: {e}')
-        if arr.ndim != 1:
+        if arr.ndim != 1 or not np.issubdtype(arr.dtype, np.integer):
             raise ValueError('prompt must be a flat int sequence')
+        vocab = getattr(self.model_cfg, 'vocab_size', None)
+        if vocab is not None and (int(arr.min()) < 0
+                                  or int(arr.max()) >= vocab):
+            raise ValueError(f'token id out of range [0, {vocab})')
 
     def prefill(self, prompt: Sequence[int]) -> Tuple[int, Any]:
         """Returns (first generated token, prefix kv) for one prompt."""
@@ -256,6 +271,8 @@ class Engine:
         for slot_id, prompt in assignments:
             by_bucket.setdefault(self._bucket(len(prompt)), []).append(
                 (slot_id, prompt))
+        pending_gets: List[Tuple[List[Tuple[int, Sequence[int]]],
+                                 jax.Array]] = []
         for bucket, group in by_bucket.items():
             i = 0
             while i < len(group):
@@ -285,9 +302,13 @@ class Engine:
                         self._cache, kv, jnp.asarray(slots),
                         jnp.asarray(true_lens), self._lengths,
                         self._tokens, toks)
-                toks_np = np.asarray(jax.device_get(toks))
-                for j, (sid, _p) in enumerate(chunk):
-                    out[sid] = int(toks_np[j])
+                # Defer the device->host read: dispatching the next
+                # chunk must not wait on this one retiring.
+                pending_gets.append((chunk, toks))
+        for chunk, toks in pending_gets:
+            toks_np = np.asarray(jax.device_get(toks))
+            for j, (sid, _p) in enumerate(chunk):
+                out[sid] = int(toks_np[j])
         return out
 
     def decode(self) -> np.ndarray:

@@ -27,7 +27,7 @@ import http.server
 import json
 import queue
 import threading
-from typing import List
+from typing import List, Optional
 
 import jax
 
@@ -67,7 +67,8 @@ class ModelServer:
 
     def __init__(self, model: str = 'tiny', port: int = 8000,
                  batch_size: int = 8, max_decode_len: int = 1024,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0,
+                 quantize: Optional[str] = None):
         cfg_factory, model_module = MODEL_PRESETS[model]
         cfg = cfg_factory()
         # Byte-level vocab must fit.
@@ -75,7 +76,8 @@ class ModelServer:
             cfg, model=model_module,
             engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
-                eos_id=EOS_ID, temperature=temperature))
+                eos_id=EOS_ID, temperature=temperature,
+                quantize=quantize))
         self.port = port
         self.ready = threading.Event()
         self.request_queue: queue.Queue = queue.Queue()
@@ -231,10 +233,14 @@ def main() -> None:
     parser.add_argument('--batch-size', type=int, default=8)
     parser.add_argument('--max-decode-len', type=int, default=1024)
     parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--quantize', choices=['int8'], default=None,
+                        help='weight-only quantization (halves weight '
+                             'HBM traffic; decode is weight-bound)')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
-                args.max_decode_len, args.temperature).serve_forever()
+                args.max_decode_len, args.temperature,
+                args.quantize).serve_forever()
 
 
 if __name__ == '__main__':

@@ -1,0 +1,111 @@
+"""Weight-only int8 quantization (ops/quant.py) + quantized serving.
+
+The reference has no in-framework quantization (serving shells out to
+vLLM/JetStream recipes); here it is an engine flag, so we can test the
+numerics directly: per-channel reconstruction error is bounded by
+scale/2, and the cached decode path under quantized weights must agree
+with the uncached forward run on the SAME quantized weights (the same
+equivalence the unquantized engine tests pin).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import quant
+from skypilot_tpu.serve import engine as engine_lib
+
+
+def test_quantize_roundtrip_error_bound():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qt = quant.quantize(w, reduce_axes=(-2,))
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (32,)
+    deq = quant.dequantize(qt, reduce_axes=(-2,), dtype=jnp.float32)
+    err = np.abs(np.asarray(deq - w))
+    bound = np.asarray(qt.scale)[None, :] * 0.5 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_qdot_matches_dequantized_matmul():
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (64, 32), jnp.float32)
+    qt = quant.quantize(w, reduce_axes=(-2,))
+    got = quant.qdot(x, qt)
+    want = x @ quant.dequantize(qt, reduce_axes=(-2,), dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_logits_close_to_dense():
+    """int8 weight-only should perturb logits only slightly (per-channel
+    symmetric, ~0.4% relative weight error)."""
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=64, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = llama.quantize_params(params)
+    tokens = jnp.asarray([[3, 17, 99, 42, 7]])
+    dense = np.asarray(llama.forward(params, tokens, cfg))
+    quantized = np.asarray(llama.forward(qparams, tokens, cfg))
+    denom = np.maximum(np.std(dense), 1e-6)
+    assert np.max(np.abs(quantized - dense)) / denom < 0.2
+
+
+def test_quantized_engine_decode_matches_quantized_forward():
+    """Cached decode with int8 weights == uncached forward on the same
+    quantized params (greedy, fp32 accumulators)."""
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = llama.quantize_params(params)
+    eng = engine_lib.Engine(
+        cfg, qparams,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8, 16)))
+    prompt = [3, 17, 99, 42, 7]
+    [got] = eng.generate_batch([prompt], max_new_tokens=8)
+
+    toks = list(prompt)
+    want = []
+    for _ in range(8):
+        logits = llama.forward(qparams, jnp.asarray([toks]), cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        toks.append(nxt)
+    assert got == want
+
+
+def test_engine_quantize_flag_and_rejection():
+    cfg = llama.llama_tiny()
+    eng = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(8,),
+            quantize='int8'))
+    assert isinstance(eng.params['lm_head'], quant.QTensor)
+    [out] = eng.generate_batch([[5, 9, 23]], max_new_tokens=4)
+    assert len(out) == 4
+    with pytest.raises(ValueError):
+        engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(quantize='fp4'))
+
+
+def test_quantized_mixtral_engine_runs():
+    from skypilot_tpu.models import mixtral
+    cfg = mixtral.MixtralConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, num_experts=4, top_k=2, capacity_factor=2.0,
+        max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,
+        remat=False, use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(8,),
+            quantize='int8'),
+        model=mixtral)
+    outs = eng.generate_batch([[3, 17, 99], [5, 9]], max_new_tokens=4)
+    assert [len(o) for o in outs] == [4, 4]
