@@ -32,6 +32,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.models import llama
@@ -81,10 +82,19 @@ class Engine:
                  params: Optional[llama.Params] = None,
                  engine_cfg: Optional[EngineConfig] = None,
                  seed: int = 0,
-                 model: Any = None):
+                 model: Any = None,
+                 mesh: Optional[Any] = None):
+        """`mesh`: a jax.sharding.Mesh for multi-chip serving (tensor /
+        expert parallelism — the reference's `vLLM --tensor-parallel-
+        size` analog, llm/mixtral/serve.yaml:40). Weights are placed per
+        the model's param_shardings (tp shards heads/ffn, ep shards
+        experts), the KV cache per llama.KV_CACHE_SPEC; XLA inserts the
+        per-layer collectives over ICI. Host-side slot logic is
+        unchanged — every jitted step is one SPMD program."""
         self.model = model if model is not None else llama
         self.model_cfg = model_cfg
         self.cfg = engine_cfg or EngineConfig()
+        self.mesh = mesh
         # A prefill bucket longer than the cache could not be inserted;
         # clamp so every bucket fits (prompt + >=1 generated token).
         self._buckets = tuple(sorted(
@@ -98,29 +108,64 @@ class Engine:
                 raise ValueError(
                     f'unsupported quantize mode {self.cfg.quantize!r} '
                     "(only 'int8')")
+            if mesh is not None:
+                raise ValueError(
+                    'quantize + mesh is not supported yet (QTensor '
+                    'scale shardings); serve dense on a mesh or int8 '
+                    'on one chip')
             params = self.model.quantize_params(params)
-        self.params = params
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
-        self._cache = self.model.init_kv_cache(model_cfg, b, t)
+        cache = self.model.init_kv_cache(model_cfg, b, t)
+
+        # Sharding plan (mesh mode): explicit jit boundaries so the
+        # cache/params keep their intended layout across every step
+        # (out_shardings=None lets XLA infer when there is no mesh).
+        repl = kv_ns = cache_ns = pshard = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            to_ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+            pshard = jax.tree.map(to_ns,
+                                  self.model.param_shardings(model_cfg))
+            params = jax.device_put(params, pshard)
+            cache_ns = {'k': to_ns(llama.KV_CACHE_SPEC),
+                        'v': to_ns(llama.KV_CACHE_SPEC)}
+            cache = jax.device_put(cache, cache_ns)
+            repl = to_ns(P())
+            kv_ns = {'k': to_ns(P(None, None, None, 'tp', None)),
+                     'v': to_ns(P(None, None, None, 'tp', None))}
+        self.params = params
+        self._cache = cache
         self._lengths = jnp.zeros((b,), jnp.int32)
         self._tokens = jnp.zeros((b,), jnp.int32)
+        if mesh is not None:
+            self._lengths = jax.device_put(self._lengths, repl)
+            self._tokens = jax.device_put(self._tokens, repl)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
 
+        def out_s(*specs):
+            return None if mesh is None else specs
+
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
-            static_argnames=())
+            out_shardings=out_s(repl, kv_ns))
         self._prefill_many_jit = jax.jit(
-            functools.partial(self._prefill_many_impl, cfg=model_cfg))
-        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,))
-        self._insert_many_jit = jax.jit(self._insert_many_impl,
-                                        donate_argnums=(0,))
+            functools.partial(self._prefill_many_impl, cfg=model_cfg),
+            out_shardings=out_s(repl, kv_ns))
+        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,),
+                                   out_shardings=out_s(cache_ns, repl,
+                                                       repl))
+        self._insert_many_jit = jax.jit(
+            self._insert_many_impl, donate_argnums=(0,),
+            out_shardings=out_s(cache_ns, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
-            donate_argnums=(1,))
+            donate_argnums=(1,),
+            out_shardings=out_s(repl, cache_ns, repl))
         self._decode_many_jit = jax.jit(
             functools.partial(self._decode_many_impl, cfg=model_cfg),
-            static_argnames=('k',), donate_argnums=(1,))
+            static_argnames=('k',), donate_argnums=(1,),
+            out_shardings=out_s(repl, cache_ns, repl, repl))
 
     # -- device programs ------------------------------------------------ #
 

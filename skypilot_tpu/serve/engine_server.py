@@ -68,12 +68,18 @@ class ModelServer:
     def __init__(self, model: str = 'tiny', port: int = 8000,
                  batch_size: int = 8, max_decode_len: int = 1024,
                  temperature: float = 0.0,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 tp: int = 1):
         cfg_factory, model_module = MODEL_PRESETS[model]
         cfg = cfg_factory()
+        mesh = None
+        if tp > 1:
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=tp),
+                                      devices=jax.devices()[:tp])
         # Byte-level vocab must fit.
         self.engine = engine_lib.Engine(
-            cfg, model=model_module,
+            cfg, model=model_module, mesh=mesh,
             engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
                 eos_id=EOS_ID, temperature=temperature,
@@ -236,11 +242,15 @@ def main() -> None:
     parser.add_argument('--quantize', choices=['int8'], default=None,
                         help='weight-only quantization (halves weight '
                              'HBM traffic; decode is weight-bound)')
+    parser.add_argument('--tp', type=int, default=1,
+                        help='tensor-parallel degree: shard the model '
+                             'over this many chips (one SPMD program, '
+                             'XLA collectives over ICI)')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
-                args.quantize).serve_forever()
+                args.quantize, args.tp).serve_forever()
 
 
 if __name__ == '__main__':
