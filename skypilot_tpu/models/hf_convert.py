@@ -1,0 +1,120 @@
+"""HuggingFace checkpoint conversion: LlamaForCausalLM -> our params.
+
+The reference consumes HF checkpoints by shelling out to torch
+(reference llm/llama-3_1-finetuning/lora.yaml, examples/tpu/v6e/
+train-llama3-8b.yaml run HF `run_clm`/torchrun on the checkpoint); here
+the weights load directly into the functional JAX model, so the same
+Llama checkpoint trains (train/trainer.py), serves (serve/engine.py,
+incl. int8 + tensor-parallel), and checkpoints (orbax) in-framework.
+
+Conventions verified against transformers' modeling_llama:
+  * torch Linear stores [out, in] -> our right-multiply mats transpose;
+  * RoPE is the half-split rotate_half form — exactly models/llama.py
+    apply_rope, so NO head-dim permutation of q/k weights is needed;
+  * RMSNorm multiplies the weight after normalization (same as
+    llama.rms_norm);
+  * tied embeddings (tie_word_embeddings) reuse embed as lm_head.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from skypilot_tpu.models import llama
+
+
+def _rope_scaling_from_hf(hf_config: Any):
+    """Map hf rope_scaling to llama.RopeScaling; raise on schemes we do
+    not implement (silently dropping one would give wrong logits for
+    every position — Llama-3.1/3.2 checkpoints all ship
+    rope_type='llama3')."""
+    rs = getattr(hf_config, 'rope_scaling', None)
+    if rs is None:
+        return None
+    rope_type = rs.get('rope_type', rs.get('type', 'default'))
+    if rope_type == 'default':
+        return None
+    if rope_type != 'llama3':
+        raise NotImplementedError(
+            f'rope_scaling rope_type={rope_type!r} is not supported '
+            "(implemented: 'llama3', 'default')")
+    return llama.RopeScaling(
+        factor=float(rs['factor']),
+        low_freq_factor=float(rs['low_freq_factor']),
+        high_freq_factor=float(rs['high_freq_factor']),
+        original_max_position_embeddings=int(
+            rs['original_max_position_embeddings']))
+
+
+def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
+                   **overrides) -> llama.LlamaConfig:
+    """LlamaConfig from a transformers LlamaConfig."""
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        dim=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=hf_config.num_key_value_heads,
+        ffn_dim=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(hf_config.rope_theta),
+        norm_eps=float(hf_config.rms_norm_eps),
+        rope_scaling=_rope_scaling_from_hf(hf_config),
+        dtype=dtype,
+    )
+    kw.update(overrides)
+    return llama.LlamaConfig(**kw)
+
+
+def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
+                  **config_overrides
+                  ) -> Tuple[llama.LlamaConfig, llama.Params]:
+    """Convert a transformers LlamaForCausalLM (torch) to
+    (LlamaConfig, params). `config_overrides` tweak the resulting
+    config (e.g. use_flash_attention=False for CPU tests)."""
+    cfg = config_from_hf(hf_model.config, dtype=dtype,
+                         **config_overrides)
+    sd = hf_model.state_dict()
+
+    def arr(key: str, transpose: bool = False) -> np.ndarray:
+        w = sd[key].detach().to('cpu').float().numpy()
+        return w.T if transpose else w
+
+    def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
+        return jnp.asarray(
+            np.stack([arr(fmt.format(i), transpose)
+                      for i in range(cfg.n_layers)])).astype(dtype)
+
+    embed = jnp.asarray(arr('model.embed_tokens.weight')).astype(dtype)
+    if getattr(hf_model.config, 'tie_word_embeddings', False):
+        lm_head = embed
+    else:
+        lm_head = jnp.asarray(arr('lm_head.weight')).astype(dtype)
+
+    params = {
+        'embed': embed,
+        'layers': {
+            'wq': stack('model.layers.{}.self_attn.q_proj.weight',
+                        transpose=True),
+            'wk': stack('model.layers.{}.self_attn.k_proj.weight',
+                        transpose=True),
+            'wv': stack('model.layers.{}.self_attn.v_proj.weight',
+                        transpose=True),
+            'wo': stack('model.layers.{}.self_attn.o_proj.weight',
+                        transpose=True),
+            'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
+                            transpose=True),
+            'w_up': stack('model.layers.{}.mlp.up_proj.weight',
+                          transpose=True),
+            'w_down': stack('model.layers.{}.mlp.down_proj.weight',
+                            transpose=True),
+            'ln_attn': stack('model.layers.{}.input_layernorm.weight'),
+            'ln_mlp': stack(
+                'model.layers.{}.post_attention_layernorm.weight'),
+        },
+        'final_norm': jnp.asarray(arr('model.norm.weight')).astype(dtype),
+        'lm_head': lm_head,
+    }
+    return cfg, params

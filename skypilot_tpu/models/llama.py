@@ -35,6 +35,18 @@ Params = Dict[str, Any]
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style RoPE frequency scaling (rope_type='llama3' in HF
+    checkpoints). Frequencies below high_freq_wavelen are kept, above
+    low_freq_wavelen divided by `factor`, in between smoothly
+    interpolated — transformers' _compute_llama3_parameters."""
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
     dim: int = 4096
@@ -54,6 +66,8 @@ class LlamaConfig:
     ring_attention: bool = False
     # vjp-friendly toggle for scanning layers; False unrolls (debugging).
     scan_layers: bool = True
+    # Llama-3.1 long-context RoPE scaling (None = plain rope_theta).
+    rope_scaling: Optional[RopeScaling] = None
 
     @property
     def head_dim(self) -> int:
@@ -126,13 +140,21 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
 
 
+# Weight leaves quantized for serving; shared with mixtral (whose param
+# tree has the same top-level shape plus extra dense leaves like
+# w_router, which the dict-copy passes through untouched).
+QUANTIZED_LAYER_KEYS = ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up',
+                        'w_down')
+
+
 def quantize_params(params: Params) -> Params:
     """Weight-only int8 for serving (ops/quant.py): every matmul weight
     gets a per-output-channel scale; norms stay dense. forward /
     decode_step accept the result directly (all weight sites go through
-    quant.qdot / qeinsum / qtake). Training never uses this."""
+    quant.qdot / qeinsum / qtake). Training never uses this.
+    Structure-generic: mixtral aliases it."""
     layers = dict(params['layers'])
-    for name in ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'):
+    for name in QUANTIZED_LAYER_KEYS:
         layers[name] = quant.quantize(layers[name], reduce_axes=(-2,))
     return {
         'embed': quant.quantize(params['embed'], reduce_axes=(-1,)),
@@ -140,6 +162,27 @@ def quantize_params(params: Params) -> Params:
         'final_norm': params['final_norm'],
         'lm_head': quant.quantize(params['lm_head'], reduce_axes=(-1,)),
     }
+
+
+def quantized_spec_tree(ps: Params) -> Params:
+    """Rewrite a param_shardings tree for a quantize_params tree: each
+    quantized weight becomes QTensor(q=<dense spec>, scale=<spec minus
+    the reduced axis>), so int8 serving composes with a tp/ep mesh.
+    The single home of the quantized-spec convention (mixtral reuses
+    it on its own param_shardings)."""
+    layers = dict(ps['layers'])
+    for name in QUANTIZED_LAYER_KEYS:
+        layers[name] = quant.qtensor_spec(layers[name], reduce_axis=-2)
+    return {
+        'embed': quant.qtensor_spec(ps['embed'], reduce_axis=-1),
+        'layers': layers,
+        'final_norm': ps['final_norm'],
+        'lm_head': quant.qtensor_spec(ps['lm_head'], reduce_axis=-1),
+    }
+
+
+def quantized_param_shardings(cfg: LlamaConfig) -> Params:
+    return quantized_spec_tree(param_shardings(cfg))
 
 
 def param_shardings(cfg: LlamaConfig) -> Params:
@@ -185,6 +228,18 @@ def rope_frequencies(cfg: LlamaConfig, positions: jax.Array) -> jax.Array:
     half = cfg.head_dim // 2
     freqs = 1.0 / (cfg.rope_theta **
                    (jnp.arange(0, half, dtype=jnp.float32) / half))
+    if cfg.rope_scaling is not None:
+        rs = cfg.rope_scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wl = rs.original_max_position_embeddings / rs.low_freq_factor
+        high_wl = rs.original_max_position_embeddings / rs.high_freq_factor
+        smooth = ((rs.original_max_position_embeddings / wavelen
+                   - rs.low_freq_factor)
+                  / (rs.high_freq_factor - rs.low_freq_factor))
+        smoothed = ((1.0 - smooth) * freqs / rs.factor + smooth * freqs)
+        freqs = jnp.where(
+            wavelen < high_wl, freqs,
+            jnp.where(wavelen > low_wl, freqs / rs.factor, smoothed))
     return positions[:, None].astype(jnp.float32) * freqs[None, :]
 
 

@@ -310,17 +310,12 @@ def make_loss_fn(cfg: MixtralConfig):
     return loss_fn
 
 
-def quantize_params(params: Params) -> Params:
-    """Weight-only int8 for serving (ops/quant.py): attention mats,
-    per-expert FFN mats ([L, E, D, F] with per-(expert, out-channel)
-    scales), embed and lm_head. The fp32 router stays dense — it is
-    tiny and routing decisions are numerically sensitive."""
-    layers = dict(params['layers'])
-    for name in ('wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'):
-        layers[name] = quant.quantize(layers[name], reduce_axes=(-2,))
-    return {
-        'embed': quant.quantize(params['embed'], reduce_axes=(-1,)),
-        'layers': layers,
-        'final_norm': params['final_norm'],
-        'lm_head': quant.quantize(params['lm_head'], reduce_axes=(-1,)),
-    }
+# Same tree shape as llama's (extra dense leaves — w_router, norms —
+# pass through): reuse its quantization + spec-rewrite wholesale. The
+# per-expert [L, E, D, F] mats get per-(expert, out-channel) scales and
+# keep their 'ep' axis, dropping the contracted one.
+quantize_params = llama.quantize_params
+
+
+def quantized_param_shardings(cfg: MixtralConfig) -> Params:
+    return llama.quantized_spec_tree(param_shardings(cfg))

@@ -93,6 +93,16 @@ def qeinsum(spec: str, x: jax.Array, w: Any, scale_insert_axes=None,
     return jnp.einsum(spec, x, w, **kwargs)
 
 
+def qtensor_spec(spec, reduce_axis: int) -> QTensor:
+    """PartitionSpec pair for a quantized weight: q keeps the dense
+    weight's spec; scale drops the reduced (contraction) axis. The spec
+    must name every axis of the weight (the model sharding tables do)."""
+    entries = list(spec)
+    del entries[reduce_axis]
+    from jax.sharding import PartitionSpec
+    return QTensor(q=spec, scale=PartitionSpec(*entries))
+
+
 def qtake(w: Any, idx: jax.Array, dtype: Any) -> jax.Array:
     """Embedding gather where the table may be a QTensor quantized with
     per-ROW scale (reduce_axes=(-1,)): gathers int8 rows + their scales

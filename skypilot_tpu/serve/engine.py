@@ -46,7 +46,10 @@ class EngineConfig:
     batch_size: int = 8               # concurrent decode slots
     max_decode_len: int = 1024        # cache length per slot
     prefill_buckets: Tuple[int, ...] = (16, 64, 256, 1024)
-    eos_id: int = -1                  # -1: never stop on a token
+    # int (-1: never stop) or tuple of ids (HF checkpoints often
+    # declare several EOS ids, e.g. Llama-3.1's [128001, 128008,
+    # 128009]).
+    eos_id: Any = -1
     temperature: float = 0.0          # 0 => greedy
     # Offline (generate_batch) decode steps fused into ONE device
     # program via lax.scan: amortizes per-step dispatch (Python + a
@@ -103,17 +106,14 @@ class Engine:
         if params is None:
             params = self.model.init_params(jax.random.PRNGKey(seed),
                                             model_cfg)
+        quantized = False
         if self.cfg.quantize is not None:
             if self.cfg.quantize != 'int8':
                 raise ValueError(
                     f'unsupported quantize mode {self.cfg.quantize!r} '
                     "(only 'int8')")
-            if mesh is not None:
-                raise ValueError(
-                    'quantize + mesh is not supported yet (QTensor '
-                    'scale shardings); serve dense on a mesh or int8 '
-                    'on one chip')
             params = self.model.quantize_params(params)
+            quantized = True
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
         cache = self.model.init_kv_cache(model_cfg, b, t)
 
@@ -124,8 +124,9 @@ class Engine:
         if mesh is not None:
             from jax.sharding import NamedSharding
             to_ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
-            pshard = jax.tree.map(to_ns,
-                                  self.model.param_shardings(model_cfg))
+            spec_fn = (self.model.quantized_param_shardings if quantized
+                       else self.model.param_shardings)
+            pshard = jax.tree.map(to_ns, spec_fn(model_cfg))
             params = jax.device_put(params, pshard)
             cache_ns = {'k': to_ns(llama.KV_CACHE_SPEC),
                         'v': to_ns(llama.KV_CACHE_SPEC)}
@@ -429,16 +430,22 @@ class Engine:
                     self._finish_if_done(slots, slot_id, results)
         return [results[i] for i in range(len(prompts))]
 
+    def _is_eos(self, tok: int) -> bool:
+        eos = self.cfg.eos_id
+        if isinstance(eos, tuple):
+            return tok in eos
+        return eos >= 0 and tok == eos
+
     def _finish_if_done(self, slots: Dict[int, _Slot], slot_id: int,
                         results: Optional[Dict[int, List[int]]]) -> None:
         slot = slots[slot_id]
         done = (len(slot.tokens) >= slot.max_new_tokens
-                or slot.tokens[-1] == self.cfg.eos_id
+                or self._is_eos(slot.tokens[-1])
                 or slot.prompt_len + len(slot.tokens)
                 >= self.cfg.max_decode_len - 1)
         if done:
             out = slot.tokens
-            if self.cfg.eos_id >= 0 and out and out[-1] == self.cfg.eos_id:
+            if out and self._is_eos(out[-1]):
                 out = out[:-1]
             if results is not None:
                 results[slot.request_id] = out
@@ -515,9 +522,7 @@ class Engine:
                     slots[slot_id] = _Slot(next_id, len(prompt), [first],
                                            max_new, out_q)
                     next_id += 1
-                    if out_q is not None and not (
-                            self.cfg.eos_id >= 0
-                            and first == self.cfg.eos_id):
+                    if out_q is not None and not self._is_eos(first):
                         out_q.put(first)
                     self._finish_if_done(slots, slot_id, None)
             if not slots:
@@ -527,7 +532,7 @@ class Engine:
                 slot = slots[slot_id]
                 tok = int(tokens[slot_id])
                 slot.tokens.append(tok)
-                if not (self.cfg.eos_id >= 0 and tok == self.cfg.eos_id):
+                if not self._is_eos(tok):
                     if slot.out_queue is not None:
                         slot.out_queue.put(tok)
                 self._finish_if_done(slots, slot_id, None)

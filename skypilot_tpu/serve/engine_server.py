@@ -69,9 +69,34 @@ class ModelServer:
                  batch_size: int = 8, max_decode_len: int = 1024,
                  temperature: float = 0.0,
                  quantize: Optional[str] = None,
-                 tp: int = 1):
-        cfg_factory, model_module = MODEL_PRESETS[model]
-        cfg = cfg_factory()
+                 tp: int = 1,
+                 hf_model: Optional[str] = None):
+        params = None
+        eos_id = EOS_ID
+        if hf_model is not None:
+            # Real checkpoint path (local dir or GCS mount): convert a
+            # transformers LlamaForCausalLM to our functional params
+            # (models/hf_convert.py); `model` preset is ignored.
+            # torch_dtype='auto' keeps the checkpoint dtype on the host
+            # (an 8B bf16 checkpoint would otherwise load as 32 GB of
+            # fp32 torch tensors before conversion).
+            import transformers
+            from skypilot_tpu.models import hf_convert
+            hf = transformers.LlamaForCausalLM.from_pretrained(
+                hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
+            cfg, params = hf_convert.from_hf_llama(hf)
+            model_module = llama
+            # The checkpoint's real EOS, not the byte-tokenizer's (a
+            # Llama-3 vocab uses id 2 as an ordinary BPE token; list-
+            # valued eos_token_id keeps every id).
+            hf_eos = hf.config.eos_token_id
+            if hf_eos is not None:
+                eos_id = (tuple(hf_eos) if isinstance(hf_eos, (list,
+                                                               tuple))
+                          else int(hf_eos))
+        else:
+            cfg_factory, model_module = MODEL_PRESETS[model]
+            cfg = cfg_factory()
         mesh = None
         if tp > 1:
             from skypilot_tpu.parallel import mesh as mesh_lib
@@ -79,10 +104,10 @@ class ModelServer:
                                       devices=jax.devices()[:tp])
         # Byte-level vocab must fit.
         self.engine = engine_lib.Engine(
-            cfg, model=model_module, mesh=mesh,
+            cfg, params, model=model_module, mesh=mesh,
             engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
-                eos_id=EOS_ID, temperature=temperature,
+                eos_id=eos_id, temperature=temperature,
                 quantize=quantize))
         self.port = port
         self.ready = threading.Event()
@@ -246,11 +271,15 @@ def main() -> None:
                         help='tensor-parallel degree: shard the model '
                              'over this many chips (one SPMD program, '
                              'XLA collectives over ICI)')
+    parser.add_argument('--hf-model', default=None,
+                        help='path to a HuggingFace Llama checkpoint '
+                             '(converted via models/hf_convert.py; '
+                             'overrides --model)')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
-                args.quantize, args.tp).serve_forever()
+                args.quantize, args.tp, args.hf_model).serve_forever()
 
 
 if __name__ == '__main__':

@@ -109,3 +109,46 @@ def test_quantized_mixtral_engine_runs():
         model=mixtral)
     outs = eng.generate_batch([[3, 17, 99], [5, 9]], max_new_tokens=4)
     assert [len(o) for o in outs] == [4, 4]
+
+
+def test_int8_with_tensor_parallel_mesh_matches_single_device():
+    """int8 + tp=2 compose: QTensor q keeps the dense weight's spec,
+    scale drops the contracted axis (quantized_param_shardings);
+    outputs must equal the single-device int8 engine's exactly."""
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    cfg = llama.LlamaConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=2),
+                              devices=jax.devices()[:2])
+    ec = engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                 prefill_buckets=(8, 16),
+                                 quantize='int8')
+    single = engine_lib.Engine(cfg, params, ec)
+    tp = engine_lib.Engine(cfg, params, ec, mesh=mesh)
+    prompts = [[3, 17, 99, 42, 7], [11, 13]]
+    assert (tp.generate_batch(prompts, max_new_tokens=6)
+            == single.generate_batch(prompts, max_new_tokens=6))
+
+
+def test_int8_with_ep_tp_mixtral_mesh():
+    from skypilot_tpu.models import mixtral
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    cfg = mixtral.MixtralConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, num_experts=4, top_k=2, capacity_factor=2.0,
+        max_seq_len=256, rope_theta=10000.0, dtype=jnp.float32,
+        remat=False, use_flash_attention=False)
+    params = mixtral.init_params(jax.random.PRNGKey(0), cfg)
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(ep=2, tp=2),
+                              devices=jax.devices()[:4])
+    ec = engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                 prefill_buckets=(8,), quantize='int8')
+    single = engine_lib.Engine(cfg, params, ec, model=mixtral)
+    sharded = engine_lib.Engine(cfg, params, ec, model=mixtral,
+                                mesh=mesh)
+    prompts = [[3, 17, 99], [5, 9]]
+    assert (sharded.generate_batch(prompts, max_new_tokens=5)
+            == single.generate_batch(prompts, max_new_tokens=5))
