@@ -17,12 +17,14 @@ Conventions verified against transformers' modeling_llama:
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import mixtral
 
 
 def _rope_scaling_from_hf(hf_config: Any):
@@ -68,53 +70,148 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
     return llama.LlamaConfig(**kw)
 
 
+def _check_supported(hcfg: Any) -> None:
+    """Raise on config features we would otherwise silently drop
+    (same convention as _rope_scaling_from_hf: wrong-logits bugs must
+    be loud)."""
+    if getattr(hcfg, 'attention_bias', False):
+        raise NotImplementedError(
+            'attention_bias=True checkpoints are not supported (q/k/v/o '
+            'biases are not modeled)')
+    if getattr(hcfg, 'sliding_window', None):
+        raise NotImplementedError(
+            f'sliding_window={hcfg.sliding_window} is not supported '
+            '(attention is global-causal)')
+
+
+def _arr(sd: Any, key: str, transpose: bool = False) -> np.ndarray:
+    """torch tensor -> HOST numpy (fp32). Staying on host matters: the
+    engine device_puts these straight into their sharded layout, so a
+    model that only fits sharded never materializes on one chip."""
+    w = sd[key].detach().to('cpu').float().numpy()
+    return w.T if transpose else w
+
+
+def _stack(sd: Any, n_layers: int, dtype: Any, fmt: str,
+           transpose: bool = False) -> np.ndarray:
+    return np.stack([_arr(sd, fmt.format(i), transpose)
+                     for i in range(n_layers)]).astype(dtype)
+
+
+def _attention_and_norms(sd: Any, n_layers: int, dtype: Any):
+    """The layer leaves Llama and Mixtral share (attention + norms)."""
+    stack = functools.partial(_stack, sd, n_layers, dtype)
+    return {
+        'wq': stack('model.layers.{}.self_attn.q_proj.weight',
+                    transpose=True),
+        'wk': stack('model.layers.{}.self_attn.k_proj.weight',
+                    transpose=True),
+        'wv': stack('model.layers.{}.self_attn.v_proj.weight',
+                    transpose=True),
+        'wo': stack('model.layers.{}.self_attn.o_proj.weight',
+                    transpose=True),
+        'ln_attn': stack('model.layers.{}.input_layernorm.weight'),
+        'ln_mlp': stack(
+            'model.layers.{}.post_attention_layernorm.weight'),
+    }
+
+
+def _embed_and_lm_head(sd: Any, hcfg: Any, dtype: Any):
+    embed = _arr(sd, 'model.embed_tokens.weight').astype(dtype)
+    if getattr(hcfg, 'tie_word_embeddings', False):
+        lm_head = embed
+    else:
+        lm_head = _arr(sd, 'lm_head.weight').astype(dtype)
+    return embed, lm_head
+
+
 def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
                   **config_overrides
                   ) -> Tuple[llama.LlamaConfig, llama.Params]:
     """Convert a transformers LlamaForCausalLM (torch) to
     (LlamaConfig, params). `config_overrides` tweak the resulting
-    config (e.g. use_flash_attention=False for CPU tests)."""
+    config (e.g. use_flash_attention=False for CPU tests). Params are
+    HOST numpy arrays (see _arr)."""
+    _check_supported(hf_model.config)
     cfg = config_from_hf(hf_model.config, dtype=dtype,
                          **config_overrides)
     sd = hf_model.state_dict()
+    stack = functools.partial(_stack, sd, cfg.n_layers, dtype)
+    embed, lm_head = _embed_and_lm_head(sd, hf_model.config, dtype)
 
-    def arr(key: str, transpose: bool = False) -> np.ndarray:
-        w = sd[key].detach().to('cpu').float().numpy()
-        return w.T if transpose else w
-
-    def stack(fmt: str, transpose: bool = False) -> jnp.ndarray:
-        return jnp.asarray(
-            np.stack([arr(fmt.format(i), transpose)
-                      for i in range(cfg.n_layers)])).astype(dtype)
-
-    embed = jnp.asarray(arr('model.embed_tokens.weight')).astype(dtype)
-    if getattr(hf_model.config, 'tie_word_embeddings', False):
-        lm_head = embed
-    else:
-        lm_head = jnp.asarray(arr('lm_head.weight')).astype(dtype)
-
+    layers = _attention_and_norms(sd, cfg.n_layers, dtype)
+    layers.update({
+        'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
+                        transpose=True),
+        'w_up': stack('model.layers.{}.mlp.up_proj.weight',
+                      transpose=True),
+        'w_down': stack('model.layers.{}.mlp.down_proj.weight',
+                        transpose=True),
+    })
     params = {
         'embed': embed,
-        'layers': {
-            'wq': stack('model.layers.{}.self_attn.q_proj.weight',
-                        transpose=True),
-            'wk': stack('model.layers.{}.self_attn.k_proj.weight',
-                        transpose=True),
-            'wv': stack('model.layers.{}.self_attn.v_proj.weight',
-                        transpose=True),
-            'wo': stack('model.layers.{}.self_attn.o_proj.weight',
-                        transpose=True),
-            'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
-                            transpose=True),
-            'w_up': stack('model.layers.{}.mlp.up_proj.weight',
-                          transpose=True),
-            'w_down': stack('model.layers.{}.mlp.down_proj.weight',
-                            transpose=True),
-            'ln_attn': stack('model.layers.{}.input_layernorm.weight'),
-            'ln_mlp': stack(
-                'model.layers.{}.post_attention_layernorm.weight'),
-        },
-        'final_norm': jnp.asarray(arr('model.norm.weight')).astype(dtype),
+        'layers': layers,
+        'final_norm': _arr(sd, 'model.norm.weight').astype(dtype),
+        'lm_head': lm_head,
+    }
+    return cfg, params
+
+
+def from_hf_mixtral(hf_model: Any, dtype: Any = jnp.bfloat16,
+                    **config_overrides
+                    ) -> Tuple[mixtral.MixtralConfig, mixtral.Params]:
+    """Convert a transformers MixtralForCausalLM to
+    (MixtralConfig, params). HF stores experts as per-expert Linears
+    (w1=gate [F,D], w2=down [D,F], w3=up [F,D]); ours are stacked
+    [L, E, D, F] batched matmuls for the one-hot dispatch formulation
+    (ops/moe.py). Routing semantics line up (softmax -> top-k ->
+    renormalize); HF's gather routing never drops tokens, which our
+    serving paths match via the drop-free capacity pin."""
+    hcfg = hf_model.config
+    kw = dict(
+        vocab_size=hcfg.vocab_size,
+        dim=hcfg.hidden_size,
+        n_layers=hcfg.num_hidden_layers,
+        n_heads=hcfg.num_attention_heads,
+        n_kv_heads=hcfg.num_key_value_heads,
+        ffn_dim=hcfg.intermediate_size,
+        num_experts=hcfg.num_local_experts,
+        top_k=hcfg.num_experts_per_tok,
+        max_seq_len=hcfg.max_position_embeddings,
+        rope_theta=float(hcfg.rope_theta),
+        norm_eps=float(hcfg.rms_norm_eps),
+        dtype=dtype,
+    )
+    kw.update(config_overrides)
+    _check_supported(hcfg)
+    cfg = mixtral.MixtralConfig(**kw)
+    sd = hf_model.state_dict()
+
+    def stack_experts(which: str) -> np.ndarray:
+        """[L, E, D, F] (gate/up) or [L, E, F, D] (down) from per-expert
+        Linears, transposed from torch's [out, in]."""
+        return np.stack([
+            np.stack([_arr(sd, f'model.layers.{i}.block_sparse_moe.'
+                           f'experts.{e}.{which}.weight', transpose=True)
+                      for e in range(cfg.num_experts)])
+            for i in range(cfg.n_layers)]).astype(dtype)
+
+    embed, lm_head = _embed_and_lm_head(sd, hcfg, dtype)
+    layers = _attention_and_norms(sd, cfg.n_layers, dtype)
+    layers.update({
+        # Router stays fp32 (models/mixtral.py init convention).
+        'w_router': np.stack(
+            [_arr(sd, f'model.layers.{i}.block_sparse_moe.gate.weight',
+                  transpose=True)
+             for i in range(cfg.n_layers)]).astype(np.float32),
+        'w_gate': stack_experts('w1'),
+        'w_up': stack_experts('w3'),
+        'w_down': stack_experts('w2'),
+    })
+    params = {
+        'embed': embed,
+        'layers': layers,
+        'final_norm': _arr(sd, 'model.norm.weight').astype(dtype),
         'lm_head': lm_head,
     }
     return cfg, params

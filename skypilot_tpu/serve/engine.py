@@ -106,34 +106,47 @@ class Engine:
         if params is None:
             params = self.model.init_params(jax.random.PRNGKey(seed),
                                             model_cfg)
-        quantized = False
-        if self.cfg.quantize is not None:
-            if self.cfg.quantize != 'int8':
-                raise ValueError(
-                    f'unsupported quantize mode {self.cfg.quantize!r} '
-                    "(only 'int8')")
-            params = self.model.quantize_params(params)
-            quantized = True
+        if self.cfg.quantize not in (None, 'int8'):
+            raise ValueError(
+                f'unsupported quantize mode {self.cfg.quantize!r} '
+                "(only 'int8')")
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
         cache = self.model.init_kv_cache(model_cfg, b, t)
 
         # Sharding plan (mesh mode): explicit jit boundaries so the
         # cache/params keep their intended layout across every step
         # (out_shardings=None lets XLA infer when there is no mesh).
-        repl = kv_ns = cache_ns = pshard = None
+        repl = kv_ns = cache_ns = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             to_ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
-            spec_fn = (self.model.quantized_param_shardings if quantized
-                       else self.model.param_shardings)
-            pshard = jax.tree.map(to_ns, spec_fn(model_cfg))
-            params = jax.device_put(params, pshard)
+            # Dense weights go straight from host to their sharded
+            # layout (hf_convert keeps them as numpy), and quantization
+            # runs SPMD on the sharded arrays — a model that only fits
+            # sharded must never materialize dense on one chip.
+            params = jax.device_put(
+                params,
+                jax.tree.map(to_ns, self.model.param_shardings(
+                    model_cfg)))
+            if self.cfg.quantize is not None:
+                params = self.model.quantize_params(params)
+                params = jax.device_put(
+                    params,
+                    jax.tree.map(to_ns,
+                                 self.model.quantized_param_shardings(
+                                     model_cfg)))
             cache_ns = {'k': to_ns(llama.KV_CACHE_SPEC),
                         'v': to_ns(llama.KV_CACHE_SPEC)}
             cache = jax.device_put(cache, cache_ns)
             repl = to_ns(P())
             kv_ns = {'k': to_ns(P(None, None, None, 'tp', None)),
                      'v': to_ns(P(None, None, None, 'tp', None))}
+        elif self.cfg.quantize is not None:
+            params = self.model.quantize_params(params)
+        else:
+            # hf_convert hands over host numpy arrays; commit them once
+            # (a numpy leaf would be re-transferred on every dispatch).
+            params = jax.device_put(params)
         self.params = params
         self._cache = cache
         self._lengths = jnp.zeros((b,), jnp.int32)
@@ -262,8 +275,8 @@ class Engine:
         """Raise ValueError for any prompt the engine cannot serve; the
         single source of truth for request validation (prefill, admit,
         and the loops all route through it)."""
-        if not prompt:
-            raise ValueError('empty prompt')
+        if len(prompt) == 0:   # not `not prompt`: numpy arrays are
+            raise ValueError('empty prompt')   # ambiguous under bool()
         if len(prompt) >= self.cfg.max_decode_len:
             raise ValueError('prompt longer than max_decode_len')
         self._bucket(len(prompt))

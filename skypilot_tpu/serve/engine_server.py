@@ -82,10 +82,22 @@ class ModelServer:
             # fp32 torch tensors before conversion).
             import transformers
             from skypilot_tpu.models import hf_convert
-            hf = transformers.LlamaForCausalLM.from_pretrained(
-                hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
-            cfg, params = hf_convert.from_hf_llama(hf)
-            model_module = llama
+            model_type = transformers.AutoConfig.from_pretrained(
+                hf_model).model_type
+            if model_type == 'mixtral':
+                hf = transformers.MixtralForCausalLM.from_pretrained(
+                    hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
+                cfg, params = hf_convert.from_hf_mixtral(hf)
+                model_module = mixtral
+            elif model_type == 'llama':
+                hf = transformers.LlamaForCausalLM.from_pretrained(
+                    hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
+                cfg, params = hf_convert.from_hf_llama(hf)
+                model_module = llama
+            else:
+                raise ValueError(
+                    f'unsupported --hf-model model_type {model_type!r} '
+                    "(supported: 'llama', 'mixtral')")
             # The checkpoint's real EOS, not the byte-tokenizer's (a
             # Llama-3 vocab uses id 2 as an ordinary BPE token; list-
             # valued eos_token_id keeps every id).
@@ -272,9 +284,9 @@ def main() -> None:
                              'over this many chips (one SPMD program, '
                              'XLA collectives over ICI)')
     parser.add_argument('--hf-model', default=None,
-                        help='path to a HuggingFace Llama checkpoint '
-                             '(converted via models/hf_convert.py; '
-                             'overrides --model)')
+                        help='path to a HuggingFace Llama or Mixtral '
+                             'checkpoint (auto-detected, converted via '
+                             'models/hf_convert.py; overrides --model)')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,

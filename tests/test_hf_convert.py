@@ -134,3 +134,30 @@ def test_multi_eos_tuple_stops_generation():
                                 eos_id=(999, eos)))
     [got] = eng2.generate_batch([prompt], max_new_tokens=6)
     assert got == probe[:2]
+
+
+def test_converted_mixtral_matches_transformers():
+    """MoE conversion pinned against transformers' MixtralForCausalLM:
+    with a drop-free capacity_factor our one-hot dispatch must equal
+    HF's gather routing exactly (same softmax -> top-k -> renormalize
+    gates)."""
+    from skypilot_tpu.models import mixtral
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, num_local_experts=4,
+        num_experts_per_tok=2, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        attn_implementation='eager')
+    torch.manual_seed(2)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg)
+    hf_model.eval()
+    cfg, params = hf_convert.from_hf_mixtral(
+        hf_model, dtype=jnp.float32, remat=False,
+        use_flash_attention=False, capacity_factor=2.0)
+    tokens = np.array([[3, 17, 99, 42, 7, 11]], np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens).long()).logits.numpy()
+    got, _aux = mixtral.forward(params, jnp.asarray(tokens), cfg)
+    np.testing.assert_allclose(np.asarray(got), want,
+                               rtol=3e-4, atol=3e-4)
