@@ -43,6 +43,10 @@ def parse_args():
                    help='Checkpoint dir (a MOUNT-mode bucket path for '
                         'spot recovery). Restores latest on start.')
     p.add_argument('--ckpt-every', type=int, default=50)
+    p.add_argument('--hf-model', default=None,
+                   help='finetune from a HuggingFace Llama/Mixtral '
+                        'checkpoint path (models/hf_convert.py) '
+                        'instead of random init; overrides --model')
     return p.parse_args()
 
 
@@ -67,11 +71,19 @@ def main():
         shape = mesh_lib.MeshShape(dp=args.dp or 1, fsdp=args.fsdp or 1,
                                    sp=args.sp, tp=args.tp, ep=args.ep)
     mesh = mesh_lib.make_mesh(shape)
-    model, preset = _PRESETS[args.model]
-    cfg = preset()
+    init_params = None
+    if args.hf_model:
+        from skypilot_tpu.models import hf_convert
+        model, cfg, init_params, _eos = hf_convert.from_hf_auto(
+            args.hf_model)
+        print(f'finetuning from HF checkpoint {args.hf_model}')
+    else:
+        model, preset = _PRESETS[args.model]
+        cfg = preset()
     print(f'{args.model} on {n} devices, mesh {shape}')
 
-    state, shardings, opt = trainer.init_train_state(cfg, mesh, model=model)
+    state, shardings, opt = trainer.init_train_state(
+        cfg, mesh, model=model, params=init_params)
     step = trainer.make_train_step(cfg, mesh, opt, shardings, model=model)
 
     # Spot-recovery resume: restore the latest checkpoint (if any) from

@@ -215,3 +215,38 @@ def from_hf_mixtral(hf_model: Any, dtype: Any = jnp.bfloat16,
         'lm_head': lm_head,
     }
     return cfg, params
+
+
+def from_hf_auto(path: str, dtype: Any = jnp.bfloat16,
+                 **config_overrides):
+    """Load + convert a checkpoint directory by model_type. Returns
+    (model_module, cfg, params, eos_id) with the torch model freed
+    before returning (peak host memory = torch weights OR numpy weights,
+    not both held alive by the caller). eos_id is an int, a tuple (HF
+    lists several for Llama-3.1), or None. The single shared loader for
+    the serving and training entry points."""
+    import transformers
+
+    model_type = transformers.AutoConfig.from_pretrained(path).model_type
+    if model_type == 'mixtral':
+        hf = transformers.MixtralForCausalLM.from_pretrained(
+            path, torch_dtype='auto', low_cpu_mem_usage=True)
+        from skypilot_tpu.models import mixtral as model_module
+        cfg, params = from_hf_mixtral(hf, dtype=dtype,
+                                      **config_overrides)
+    elif model_type == 'llama':
+        hf = transformers.LlamaForCausalLM.from_pretrained(
+            path, torch_dtype='auto', low_cpu_mem_usage=True)
+        from skypilot_tpu.models import llama as model_module
+        cfg, params = from_hf_llama(hf, dtype=dtype, **config_overrides)
+    else:
+        raise ValueError(
+            f'unsupported HF model_type {model_type!r} '
+            "(supported: 'llama', 'mixtral')")
+    eos = hf.config.eos_token_id
+    del hf
+    if isinstance(eos, (list, tuple)):
+        eos = tuple(eos)
+    elif eos is not None:
+        eos = int(eos)
+    return model_module, cfg, params, eos

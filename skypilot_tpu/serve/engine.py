@@ -141,11 +141,12 @@ class Engine:
             repl = to_ns(P())
             kv_ns = {'k': to_ns(P(None, None, None, 'tp', None)),
                      'v': to_ns(P(None, None, None, 'tp', None))}
-        elif self.cfg.quantize is not None:
-            params = self.model.quantize_params(params)
         else:
-            # hf_convert hands over host numpy arrays; commit them once
-            # (a numpy leaf would be re-transferred on every dispatch).
+            if self.cfg.quantize is not None:
+                params = self.model.quantize_params(params)
+            # hf_convert hands over host numpy arrays; commit the tree
+            # once (quantize passes norm/router leaves through, and any
+            # numpy leaf would be re-transferred on every dispatch).
             params = jax.device_put(params)
         self.params = params
         self._cache = cache

@@ -80,32 +80,13 @@ class ModelServer:
             # torch_dtype='auto' keeps the checkpoint dtype on the host
             # (an 8B bf16 checkpoint would otherwise load as 32 GB of
             # fp32 torch tensors before conversion).
-            import transformers
             from skypilot_tpu.models import hf_convert
-            model_type = transformers.AutoConfig.from_pretrained(
-                hf_model).model_type
-            if model_type == 'mixtral':
-                hf = transformers.MixtralForCausalLM.from_pretrained(
-                    hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
-                cfg, params = hf_convert.from_hf_mixtral(hf)
-                model_module = mixtral
-            elif model_type == 'llama':
-                hf = transformers.LlamaForCausalLM.from_pretrained(
-                    hf_model, torch_dtype='auto', low_cpu_mem_usage=True)
-                cfg, params = hf_convert.from_hf_llama(hf)
-                model_module = llama
-            else:
-                raise ValueError(
-                    f'unsupported --hf-model model_type {model_type!r} '
-                    "(supported: 'llama', 'mixtral')")
+            model_module, cfg, params, hf_eos = hf_convert.from_hf_auto(
+                hf_model)
             # The checkpoint's real EOS, not the byte-tokenizer's (a
-            # Llama-3 vocab uses id 2 as an ordinary BPE token; list-
-            # valued eos_token_id keeps every id).
-            hf_eos = hf.config.eos_token_id
+            # Llama-3 vocab uses id 2 as an ordinary BPE token).
             if hf_eos is not None:
-                eos_id = (tuple(hf_eos) if isinstance(hf_eos, (list,
-                                                               tuple))
-                          else int(hf_eos))
+                eos_id = hf_eos
         else:
             cfg_factory, model_module = MODEL_PRESETS[model]
             cfg = cfg_factory()

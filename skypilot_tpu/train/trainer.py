@@ -114,22 +114,44 @@ def state_shardings(cfg: Any, mesh: Mesh,
 def init_train_state(cfg: Any, mesh: Mesh,
                      optimizer: Optional[optax.GradientTransformation] = None,
                      seed: int = 0,
-                     model: Any = llama
+                     model: Any = llama,
+                     params: Any = None
                      ) -> Tuple[TrainState, TrainState, Any]:
     """Initialize params/opt-state directly sharded on the mesh (no host
     round-trip: jit with out_shardings materializes each shard on its
-    device). Returns (state, shardings, optimizer)."""
+    device). Returns (state, shardings, optimizer).
+
+    `params`: existing weights to finetune from (e.g. a converted HF
+    checkpoint, models/hf_convert.py — the in-framework analog of the
+    reference's llm/llama-3_1-finetuning torchrun recipe). Host numpy
+    leaves go straight into their sharded layout; only the optimizer
+    state is initialized on-device."""
     optimizer = optimizer or default_optimizer()
-    params_struct = jax.eval_shape(
-        functools.partial(model.init_params, cfg=cfg), jax.random.PRNGKey(0))
+    if params is not None:
+        params_struct = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
+    else:
+        params_struct = jax.eval_shape(
+            functools.partial(model.init_params, cfg=cfg),
+            jax.random.PRNGKey(0))
     opt_struct = jax.eval_shape(optimizer.init, params_struct)
     shardings = state_shardings(cfg, mesh, params_struct, opt_struct,
                                 model=model)
 
+    if params is not None:
+        params = jax.device_put(params, shardings.params)
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=shardings.opt_state)(params)
+        state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32),
+                                shardings.step),
+            params=params, opt_state=opt_state)
+        return state, shardings, optimizer
+
     def _init(key):
-        params = model.init_params(key, cfg)
-        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                          opt_state=optimizer.init(params))
+        init = model.init_params(key, cfg)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=init,
+                          opt_state=optimizer.init(init))
 
     state = jax.jit(_init, out_shardings=shardings)(
         jax.random.PRNGKey(seed))
