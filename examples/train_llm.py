@@ -43,6 +43,12 @@ def parse_args():
                    help='Checkpoint dir (a MOUNT-mode bucket path for '
                         'spot recovery). Restores latest on start.')
     p.add_argument('--ckpt-every', type=int, default=50)
+    p.add_argument('--tokens-gcs', default=None,
+                   help='dir of .npy token shards (local or a '
+                        'MOUNT-mode bucket path); synthetic data when '
+                        'unset. Shards stride across hosts; a '
+                        'background thread prefetches batches '
+                        '(data/token_loader.py).')
     p.add_argument('--hf-model', default=None,
                    help='finetune from a HuggingFace Llama/Mixtral '
                         'checkpoint path (models/hf_convert.py) '
@@ -101,10 +107,41 @@ def main():
             print(f'resumed from checkpoint step {latest} '
                   f'({args.ckpt_dir})')
 
-    key = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(
-        key, (args.batch_size, args.seq_len + 1), 0, cfg.vocab_size)
-    batch = {'tokens': tokens}
+    loader = None
+    if args.tokens_gcs:
+        from jax.sharding import NamedSharding, PartitionSpec
+        from skypilot_tpu.data import token_loader
+        # Each host loads its OWN rows of the global batch and the
+        # global sharded array is assembled from the per-process local
+        # data — feeding full host-local arrays into a ('dp','fsdp')-
+        # sharded jit would silently train on 1/hosts of each one.
+        n_proc = jax.process_count()
+        if args.batch_size % n_proc != 0:
+            raise ValueError(f'--batch-size {args.batch_size} must be '
+                             f'divisible by {n_proc} hosts')
+        loader = token_loader.TokenLoader(
+            args.tokens_gcs, args.batch_size // n_proc, args.seq_len,
+            skip_batches=start_step)
+        batch_sharding = NamedSharding(
+            mesh, PartitionSpec(('dp', 'fsdp'), None))
+
+        def next_batch():
+            local = next(loader)
+            if (int(local.max()) >= cfg.vocab_size
+                    or int(local.min()) < 0):
+                raise ValueError(
+                    f'token ids [{int(local.min())}, {int(local.max())}]'
+                    f' outside [0, {cfg.vocab_size}) — shards tokenized '
+                    'with a different vocabulary?')
+            return {'tokens': jax.make_array_from_process_local_data(
+                batch_sharding, local)}
+
+        batch = next_batch()
+    else:
+        key = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(
+            key, (args.batch_size, args.seq_len + 1), 0, cfg.vocab_size)
+        batch = {'tokens': tokens}
 
     callbacks.init(total_steps=args.steps)
     tokens_per_step = args.batch_size * args.seq_len
@@ -112,6 +149,8 @@ def main():
     done = 0
     for i in range(start_step, args.steps):
         state, metrics = step(state, batch)
+        if loader is not None and i + 1 < args.steps:
+            batch = next_batch()   # prefetch overlapped with the step
         jax.block_until_ready(metrics['loss'])
         callbacks.on_step_end()
         done += 1
@@ -124,6 +163,8 @@ def main():
             ckpt.save(i, state)
     if ckpt is not None:
         ckpt.close()
+    if loader is not None:
+        loader.close()
     callbacks.close()
 
 

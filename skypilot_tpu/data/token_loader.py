@@ -1,0 +1,172 @@
+"""Token-shard data loader for LLM training: npy shards -> host batches.
+
+The reference delegates data loading entirely to user frameworks (its
+Llama recipes run HF `run_clm` over HF datasets — reference
+examples/tpu/v6e/train-llama3-8b.yaml); here the loader is a framework
+component shaped for the TPU input pipeline:
+
+  * shards are plain `.npy` files of token ids (any dtype castable to
+    int32, flattened or [N, S]) in a local dir or a MOUNT-mode GCS
+    bucket path — works unchanged on a gcsfuse mount (data/storage.py);
+  * each host reads a disjoint stride of the shard list
+    (`process_index :: process_count`) and yields its LOCAL rows of the
+    global batch; the caller assembles the global sharded array with
+    `jax.make_array_from_process_local_data` (examples/train_llm.py) —
+    a multi-host pod never reads a byte twice;
+  * a background thread prefetches and packs the next batch while the
+    current step runs on-device (double buffering hides read+pack
+    latency behind compute); shards are mmap'd and copied one batch
+    window at a time, so host RSS stays at one batch, not one shard;
+  * batches are [B, seq_len + 1] int32 windows (targets are the inputs
+    shifted by one, train/trainer.py convention); shard ORDER shuffles
+    per epoch from `seed` (contents stay sequential within a shard) —
+    deterministic per (shards, seed);
+  * `skip_batches` fast-forwards without copying (mmap offsets advance,
+    pages are never touched) so a resumed spot job continues from the
+    data position its checkpoint step implies.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def list_shards(path: str) -> List[str]:
+    """All .npy files under `path` (non-recursive), sorted."""
+    names = sorted(n for n in os.listdir(path) if n.endswith('.npy'))
+    if not names:
+        raise FileNotFoundError(f'no .npy token shards under {path!r}')
+    return [os.path.join(path, n) for n in names]
+
+
+class TokenLoader:
+    """Iterates [B, seq_len + 1] int32 batches from npy token shards.
+
+    `process_index`/`process_count` stride the shard list across hosts
+    (defaults: this process's jax ids when jax is initialized, else
+    single-host). A host owning zero shards wraps onto the full list
+    offset by its index, so tiny datasets still feed every host.
+    B here is the PER-HOST row count (global batch / process_count)."""
+
+    def __init__(self, path: str, batch_size: int, seq_len: int,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 seed: int = 0, prefetch: int = 2,
+                 skip_batches: int = 0):
+        if process_index is None or process_count is None:
+            try:
+                import jax
+                process_index = jax.process_index()
+                process_count = jax.process_count()
+            except Exception:  # noqa: BLE001 — jax not initialized
+                process_index, process_count = 0, 1
+        shards = list_shards(path)
+        mine = shards[process_index::process_count]
+        if not mine:
+            mine = shards[process_index % len(shards):] + \
+                shards[:process_index % len(shards)]
+        self._shards = mine
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self._seed = seed
+        self._skip_tokens = skip_batches * batch_size * (seq_len + 1)
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer,
+                                        daemon=True)
+        self._thread.start()
+        logger.debug('TokenLoader: %d shards for host %d/%d under %s',
+                     len(mine), process_index, process_count, path)
+
+    def _shard_epochs(self):
+        """Yields mmap'd flat shard views forever; shard ORDER reshuffles
+        per epoch from the seed (same seed => same stream)."""
+        rng = np.random.RandomState(self._seed)
+        while True:
+            order = list(self._shards)
+            rng.shuffle(order)
+            for shard in order:
+                yield np.load(shard, mmap_mode='r').reshape(-1)
+
+    def _producer(self) -> None:
+        window = self.seq_len + 1
+        need = self.batch_size * window
+        carry = np.zeros((0,), np.int32)
+        to_skip = self._skip_tokens
+        try:
+            epoch_tokens = 0
+            shards_left = len(self._shards)
+            for flat in self._shard_epochs():
+                if self._stop.is_set():
+                    return
+                epoch_tokens += flat.size
+                shards_left -= 1
+                if shards_left == 0:
+                    # All-empty shard sets must error, not busy-spin
+                    # epochs while next() hangs forever.
+                    if epoch_tokens == 0:
+                        raise ValueError(
+                            f'token shards contain 0 tokens '
+                            f'({len(self._shards)} files)')
+                    epoch_tokens = 0
+                    shards_left = len(self._shards)
+                pos = 0
+                if to_skip:
+                    # Fast-forward by advancing the offset — the mmap
+                    # pages are never touched, so resume costs no I/O.
+                    jump = min(to_skip, flat.size)
+                    pos += jump
+                    to_skip -= jump
+                while pos < flat.size:
+                    take = min(need - carry.size, flat.size - pos)
+                    # np.array (NOT asarray: for int32 shards asarray
+                    # returns a live mmap VIEW, and the read would then
+                    # happen as page faults on the consumer thread) —
+                    # copy exactly one window's worth out of the mmap:
+                    # RSS stays at one batch, not one shard.
+                    chunk = np.array(flat[pos:pos + take],
+                                     dtype=np.int32)
+                    carry = np.concatenate([carry, chunk]) \
+                        if carry.size else chunk
+                    pos += take
+                    if carry.size < need:
+                        continue
+                    batch = carry.reshape(self.batch_size, window)
+                    carry = np.zeros((0,), np.int32)
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(batch, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+        except Exception as e:  # noqa: BLE001 — surface via next()
+            if not self._stop.is_set():
+                self._queue.put(e)
+
+    def __iter__(self) -> 'TokenLoader':
+        return self
+
+    def __next__(self) -> np.ndarray:
+        item = self._queue.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock a producer stuck on a full queue.
+        try:
+            self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

@@ -1,0 +1,161 @@
+"""Token-shard loader (data/token_loader.py): shapes, determinism,
+host striding, prefetch lifecycle, and the train_llm.py integration.
+"""
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.data import token_loader
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    for i in range(3):
+        np.save(tmp_path / f'shard_{i}.npy',
+                rng.randint(0, 500, size=1000, dtype=np.int64))
+    return str(tmp_path)
+
+
+def test_batch_shape_and_content(shard_dir):
+    loader = token_loader.TokenLoader(shard_dir, batch_size=4, seq_len=16,
+                                      process_index=0, process_count=1,
+                                      seed=0)
+    try:
+        batch = next(loader)
+        assert batch.shape == (4, 17)
+        assert batch.dtype == np.int32
+        # First batch = first 4*17 tokens of the seed-0 epoch's first
+        # shard (order shuffles per epoch, contents stay sequential).
+        rng = np.random.RandomState(0)
+        order = token_loader.list_shards(shard_dir)
+        rng.shuffle(order)
+        want = np.load(order[0]).reshape(-1)[:68]
+        np.testing.assert_array_equal(batch.reshape(-1), want)
+    finally:
+        loader.close()
+
+
+def test_seed_changes_and_determinism(shard_dir):
+    def first(seed):
+        ld = token_loader.TokenLoader(shard_dir, 2, 8, process_index=0,
+                                      process_count=1, seed=seed)
+        try:
+            return next(ld)
+        finally:
+            ld.close()
+
+    np.testing.assert_array_equal(first(0), first(0))
+    seeds = [first(s).tobytes() for s in range(6)]
+    assert len(set(seeds)) > 1   # some seed reorders the shards
+
+
+def test_skip_batches_fast_forwards(shard_dir):
+    ld = token_loader.TokenLoader(shard_dir, 2, 8, process_index=0,
+                                  process_count=1, seed=0)
+    try:
+        next(ld)
+        second = next(ld)
+    finally:
+        ld.close()
+    skipped = token_loader.TokenLoader(shard_dir, 2, 8, process_index=0,
+                                       process_count=1, seed=0,
+                                       skip_batches=1)
+    try:
+        np.testing.assert_array_equal(next(skipped), second)
+    finally:
+        skipped.close()
+
+
+def test_wraparound_keeps_producing(shard_dir):
+    loader = token_loader.TokenLoader(shard_dir, batch_size=8, seq_len=64,
+                                      process_index=0, process_count=1)
+    try:
+        for _ in range(10):    # 10 * 8 * 65 = 5200 > 3000 total tokens
+            batch = next(loader)
+            assert batch.shape == (8, 65)
+    finally:
+        loader.close()
+
+
+def test_hosts_read_disjoint_shards(shard_dir):
+    l0 = token_loader.TokenLoader(shard_dir, batch_size=2, seq_len=8,
+                                  process_index=0, process_count=2)
+    l1 = token_loader.TokenLoader(shard_dir, batch_size=2, seq_len=8,
+                                  process_index=1, process_count=2)
+    try:
+        assert set(l0._shards).isdisjoint(l1._shards)
+        assert set(l0._shards) | set(l1._shards) == set(
+            token_loader.list_shards(shard_dir))
+    finally:
+        l0.close()
+        l1.close()
+
+
+def test_more_hosts_than_shards_still_feeds_everyone(shard_dir):
+    loaders = [token_loader.TokenLoader(shard_dir, 1, 8,
+                                        process_index=i, process_count=5)
+               for i in range(5)]
+    try:
+        for ld in loaders:
+            assert next(ld).shape == (1, 9)
+    finally:
+        for ld in loaders:
+            ld.close()
+
+
+def test_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        token_loader.list_shards(str(tmp_path))
+
+
+def test_train_llm_with_token_shards(shard_dir, tmp_path):
+    """train_llm.py --tokens-gcs end to end on the CPU mesh."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # PYTHONPATH is replaced, not extended: an inherited TPU-tunnel
+    # sitecustomize would force its platform over JAX_PLATFORMS=cpu.
+    env = dict(os.environ,
+               JAX_PLATFORMS='cpu',
+               XLA_FLAGS='--xla_force_host_platform_device_count=4',
+               PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, 'examples/train_llm.py', '--model', 'llama-tiny',
+         '--steps', '3', '--batch-size', '2', '--seq-len', '32',
+         '--fsdp', '2', '--tp', '2', '--tokens-gcs', shard_dir],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert 'loss' in out.stdout
+
+
+def test_all_empty_shards_raise(tmp_path):
+    for i in range(2):
+        np.save(tmp_path / f'empty_{i}.npy', np.zeros((0,), np.int64))
+    ld = token_loader.TokenLoader(str(tmp_path), 2, 8, process_index=0,
+                                  process_count=1)
+    try:
+        with pytest.raises(ValueError):
+            next(ld)
+    finally:
+        ld.close()
+
+
+def test_int32_shards_are_copied_not_viewed(tmp_path):
+    """int32 shards must still be copied out of the mmap — a view would
+    move the real I/O onto the consumer thread and pin whole shards."""
+    np.save(tmp_path / 's.npy',
+            np.arange(4000, dtype=np.int32))
+    ld = token_loader.TokenLoader(str(tmp_path), 2, 8, process_index=0,
+                                  process_count=1)
+    try:
+        batch = next(ld)
+        base = batch
+        while base.base is not None:
+            base = base.base
+        assert not isinstance(base, np.memmap)
+    finally:
+        ld.close()
