@@ -64,6 +64,21 @@ class EngineConfig:
     quantize: Optional[str] = None
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decoding controls (vLLM/JetStream API parity; the
+    engine keeps them as per-slot vectors so one SPMD decode program
+    serves a batch of heterogeneous requests).
+
+    temperature <= 0 is greedy. top_k <= 0 and top_p >= 1 disable the
+    respective filters. Nucleus/top-k candidate selection is computed
+    over the top-64 logits (exact whenever the nucleus fits in 64
+    candidates — the practical case)."""
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -152,9 +167,18 @@ class Engine:
         self._cache = cache
         self._lengths = jnp.zeros((b,), jnp.int32)
         self._tokens = jnp.zeros((b,), jnp.int32)
+        # Per-slot sampling controls (SamplingParams); defaults come
+        # from the engine config so the old global-temperature behavior
+        # is the no-request-params case.
+        self._temps = jnp.full((b,), self.cfg.temperature, jnp.float32)
+        self._topks = jnp.zeros((b,), jnp.int32)
+        self._topps = jnp.ones((b,), jnp.float32)
         if mesh is not None:
             self._lengths = jax.device_put(self._lengths, repl)
             self._tokens = jax.device_put(self._tokens, repl)
+            self._temps = jax.device_put(self._temps, repl)
+            self._topks = jax.device_put(self._topks, repl)
+            self._topps = jax.device_put(self._topps, repl)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
 
@@ -167,12 +191,12 @@ class Engine:
         self._prefill_many_jit = jax.jit(
             functools.partial(self._prefill_many_impl, cfg=model_cfg),
             out_shardings=out_s(repl, kv_ns))
-        self._insert_jit = jax.jit(self._insert_impl, donate_argnums=(0,),
-                                   out_shardings=out_s(cache_ns, repl,
-                                                       repl))
+        self._insert_jit = jax.jit(
+            self._insert_impl, donate_argnums=(0,),
+            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
         self._insert_many_jit = jax.jit(
             self._insert_many_impl, donate_argnums=(0,),
-            out_shardings=out_s(cache_ns, repl, repl))
+            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
             donate_argnums=(1,),
@@ -184,24 +208,58 @@ class Engine:
 
     # -- device programs ------------------------------------------------ #
 
-    @staticmethod
-    def _sample(logits: jax.Array, key: jax.Array,
-                temperature: float) -> jax.Array:
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
+    # Candidate pool for top-k / nucleus filtering (see SamplingParams).
+    _MAX_TOPK = 64
 
-    def _prefill_impl(self, params, tokens, true_len, key, cfg):
+    def _sample(self, logits: jax.Array, key: jax.Array,
+                temps: jax.Array, topks: jax.Array,
+                topps: jax.Array) -> jax.Array:
+        """Batched per-row sampling: logits [B, V], per-row temperature
+        (<=0 greedy), top-k (<=0 off) and top-p (>=1 off). One compiled
+        program regardless of the mix."""
+        logits = logits.astype(jnp.float32)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
+        scaled = logits / safe_t
+
+        kk = min(self._MAX_TOPK, scaled.shape[-1])
+        vals, _ = jax.lax.top_k(scaled, kk)                   # [B, kk]
+        k = jnp.clip(jnp.where(topks <= 0, kk, topks), 1, kk)
+        kth = jnp.take_along_axis(vals, (k - 1)[:, None], axis=-1)
+        # Candidate probabilities under the FULL distribution (softmax
+        # over only the 64 candidates would inflate every cumsum and
+        # shrink the kept nucleus below the requested top_p whenever
+        # mass lives outside the candidate set).
+        lse = jax.nn.logsumexp(scaled, axis=-1, keepdims=True)
+        probs = jnp.exp(vals - lse)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Nucleus: keep candidate j while the mass BEFORE it is < p
+        # (the first candidate always stays).
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool),
+             cum[:, :-1] < topps[:, None]], axis=-1)
+        pth = jnp.min(jnp.where(keep, vals, jnp.inf), axis=-1,
+                      keepdims=True)
+        thresh = jnp.maximum(kth, pth)
+        needs_filter = ((topks > 0) | (topps < 1.0))[:, None]
+        final = jnp.where(needs_filter & (scaled < thresh),
+                          -jnp.inf, scaled)
+        sampled = jax.random.categorical(key, final,
+                                         axis=-1).astype(jnp.int32)
+        return jnp.where(temps <= 0, greedy, sampled)
+
+    def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
+                      topp, cfg):
         """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[0, true_len - 1]
-        tok = self._sample(last[None], key, self.cfg.temperature)[0]
+        tok = self._sample(last[None], key, temp[None], topk[None],
+                           topp[None])[0]
         return tok, kv
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
-                     first_token):
+                     first_token, temps, topks, topps, temp, topk, topp):
         """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`."""
         new_cache = {}
         for name in ('k', 'v'):
@@ -212,9 +270,13 @@ class Engine:
             new_cache[name] = jnp.swapaxes(dst, 0, 1)
         lengths = lengths.at[slot].set(length)
         tokens = tokens.at[slot].set(first_token)
-        return new_cache, lengths, tokens
+        temps = temps.at[slot].set(temp)
+        topks = topks.at[slot].set(topk)
+        topps = topps.at[slot].set(topp)
+        return new_cache, lengths, tokens, temps, topks, topps
 
-    def _prefill_many_impl(self, params, tokens, true_lens, key, cfg):
+    def _prefill_many_impl(self, params, tokens, true_lens, key,
+                           temps, topks, topps, cfg):
         """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
         Returns (first_tokens [N], kv [L, N, S, KV, hd]). Rows are
         independent (causal attention; the MoE path pins a drop-free
@@ -223,11 +285,12 @@ class Engine:
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
-        toks = self._sample(last, key, self.cfg.temperature)
+        toks = self._sample(last, key, temps, topks, topps)
         return toks, kv
 
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
-                          lengths, tokens, first_tokens):
+                          lengths, tokens, first_tokens, temps, topks,
+                          topps, temps_new, topks_new, topps_new):
         """Scatter prefix kv [L,N,S,KV,hd] into cache rows `slots` [N]
         (distinct), one device program for the whole wave."""
         s = prefix_kv['k'].shape[2]
@@ -238,23 +301,27 @@ class Engine:
                 prefix_kv[name].astype(dst.dtype))
         lengths = lengths.at[slots].set(lengths_new)
         tokens = tokens.at[slots].set(first_tokens)
-        return new_cache, lengths, tokens
+        temps = temps.at[slots].set(temps_new)
+        topks = topks.at[slots].set(topks_new)
+        topps = topps.at[slots].set(topps_new)
+        return new_cache, lengths, tokens, temps, topks, topps
 
-    def _decode_impl(self, params, cache, lengths, tokens, key, cfg):
+    def _decode_impl(self, params, cache, lengths, tokens, key, temps,
+                     topks, topps, cfg):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-        next_tokens = self._sample(logits, key, self.cfg.temperature)
+        next_tokens = self._sample(logits, key, temps, topks, topps)
         return next_tokens, new_cache, lengths + 1
 
-    def _decode_many_impl(self, params, cache, lengths, tokens, key, k,
-                          cfg):
+    def _decode_many_impl(self, params, cache, lengths, tokens, key,
+                          temps, topks, topps, k, cfg):
         """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
         One dispatch + one host transfer per k tokens."""
         def body(carry, subkey):
             cache, lengths, tokens = carry
             logits, cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-            nt = self._sample(logits, subkey, self.cfg.temperature)
+            nt = self._sample(logits, subkey, temps, topks, topps)
             return (cache, lengths + 1, nt), nt
 
         keys = jax.random.split(key, k)
@@ -292,47 +359,65 @@ class Engine:
                                   or int(arr.max()) >= vocab):
             raise ValueError(f'token id out of range [0, {vocab})')
 
-    def prefill(self, prompt: Sequence[int]) -> Tuple[int, Any]:
+    def _sampling_or_default(self, sampling) -> SamplingParams:
+        if sampling is None:
+            return SamplingParams(temperature=self.cfg.temperature)
+        return sampling
+
+    def prefill(self, prompt: Sequence[int],
+                sampling: Optional[SamplingParams] = None
+                ) -> Tuple[int, Any]:
         """Returns (first generated token, prefix kv) for one prompt."""
         self._validate(prompt)
+        sp = self._sampling_or_default(sampling)
         bucket = self._bucket(len(prompt))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(prompt)] = prompt
         self._key, sub = jax.random.split(self._key)
-        tok, kv = self._prefill_jit(self.params, jnp.asarray(padded),
-                                    len(prompt), sub)
+        tok, kv = self._prefill_jit(
+            self.params, jnp.asarray(padded), len(prompt), sub,
+            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+            jnp.float32(sp.top_p))
         return int(tok), kv
 
     def insert(self, prefix_kv: Any, slot: int, length: int,
-               first_token: int) -> None:
-        self._cache, self._lengths, self._tokens = self._insert_jit(
+               first_token: int,
+               sampling: Optional[SamplingParams] = None) -> None:
+        sp = self._sampling_or_default(sampling)
+        (self._cache, self._lengths, self._tokens, self._temps,
+         self._topks, self._topps) = self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
-            self._tokens, first_token)
+            self._tokens, first_token, self._temps, self._topks,
+            self._topps, jnp.float32(sp.temperature),
+            jnp.int32(sp.top_k), jnp.float32(sp.top_p))
 
     # Cap on one batched-prefill dispatch: bounds the transient
     # [L, N, S, KV, hd] prefill-kv buffer and the number of distinct
     # (bucket, N) executables (N is a power of two <= this).
     _MAX_PREFILL_GROUP = 16
 
-    def admit(self, assignments: Sequence[Tuple[int, Sequence[int]]]
-              ) -> Dict[int, int]:
-        """Prefill + insert a wave of (slot_id, prompt) pairs; returns
-        {slot_id: first_token}. Same-bucket prompts are grouped into
-        power-of-two batched prefills — one forward + one cache scatter
-        per group instead of two dispatches per prompt, which is what
-        dominates wall-clock when many requests arrive at once (each
-        dispatch is a host round trip). Validates every prompt up front
-        and raises BEFORE touching any engine state, so a bad prompt in
-        a wave cannot leave a partially-admitted batch behind."""
-        for _slot_id, prompt in assignments:
+    def admit(self, assignments: Sequence[Tuple]) -> Dict[int, int]:
+        """Prefill + insert a wave of (slot_id, prompt) or (slot_id,
+        prompt, SamplingParams) tuples; returns {slot_id: first_token}.
+        Same-bucket prompts are grouped into power-of-two batched
+        prefills — one forward + one cache scatter per group instead of
+        two dispatches per prompt, which is what dominates wall-clock
+        when many requests arrive at once (each dispatch is a host
+        round trip). Validates every prompt up front and raises BEFORE
+        touching any engine state, so a bad prompt in a wave cannot
+        leave a partially-admitted batch behind."""
+        norm = []
+        for a in assignments:
+            slot_id, prompt = a[0], a[1]
+            sp = self._sampling_or_default(a[2] if len(a) > 2 else None)
             self._validate(prompt)
+            norm.append((slot_id, prompt, sp))
         out: Dict[int, int] = {}
-        by_bucket: Dict[int, List[Tuple[int, Sequence[int]]]] = {}
-        for slot_id, prompt in assignments:
+        by_bucket: Dict[int, List[Tuple]] = {}
+        for slot_id, prompt, sp in norm:
             by_bucket.setdefault(self._bucket(len(prompt)), []).append(
-                (slot_id, prompt))
-        pending_gets: List[Tuple[List[Tuple[int, Sequence[int]]],
-                                 jax.Array]] = []
+                (slot_id, prompt, sp))
+        pending_gets: List[Tuple[List[Tuple], jax.Array]] = []
         for bucket, group in by_bucket.items():
             i = 0
             while i < len(group):
@@ -342,32 +427,41 @@ class Engine:
                 chunk = group[i:i + n]
                 i += n
                 if n == 1:
-                    slot_id, prompt = chunk[0]
-                    first, kv = self.prefill(prompt)
-                    self.insert(kv, slot_id, len(prompt), first)
+                    slot_id, prompt, sp = chunk[0]
+                    first, kv = self.prefill(prompt, sampling=sp)
+                    self.insert(kv, slot_id, len(prompt), first,
+                                sampling=sp)
                     out[slot_id] = first
                     continue
                 padded = np.zeros((n, bucket), np.int32)
-                for j, (_sid, p) in enumerate(chunk):
+                for j, (_sid, p, _sp) in enumerate(chunk):
                     padded[j, :len(p)] = p
-                true_lens = np.array([len(p) for _s, p in chunk],
+                true_lens = np.array([len(p) for _s, p, _sp in chunk],
                                      np.int32)
-                slots = np.array([s for s, _p in chunk], np.int32)
+                slots = np.array([s for s, _p, _sp in chunk], np.int32)
+                temps = jnp.asarray([sp.temperature
+                                     for _s, _p, sp in chunk],
+                                    jnp.float32)
+                topks = jnp.asarray([sp.top_k for _s, _p, sp in chunk],
+                                    jnp.int32)
+                topps = jnp.asarray([sp.top_p for _s, _p, sp in chunk],
+                                    jnp.float32)
                 self._key, sub = jax.random.split(self._key)
                 toks, kv = self._prefill_many_jit(
                     self.params, jnp.asarray(padded),
-                    jnp.asarray(true_lens), sub)
-                self._cache, self._lengths, self._tokens = \
-                    self._insert_many_jit(
-                        self._cache, kv, jnp.asarray(slots),
-                        jnp.asarray(true_lens), self._lengths,
-                        self._tokens, toks)
+                    jnp.asarray(true_lens), sub, temps, topks, topps)
+                (self._cache, self._lengths, self._tokens, self._temps,
+                 self._topks, self._topps) = self._insert_many_jit(
+                    self._cache, kv, jnp.asarray(slots),
+                    jnp.asarray(true_lens), self._lengths,
+                    self._tokens, toks, self._temps, self._topks,
+                    self._topps, temps, topks, topps)
                 # Defer the device->host read: dispatching the next
                 # chunk must not wait on this one retiring.
                 pending_gets.append((chunk, toks))
         for chunk, toks in pending_gets:
             toks_np = np.asarray(jax.device_get(toks))
-            for j, (sid, _p) in enumerate(chunk):
+            for j, (sid, _p, _sp) in enumerate(chunk):
                 out[sid] = int(toks_np[j])
         return out
 
@@ -375,7 +469,8 @@ class Engine:
         """One decode step for every slot; returns the [B] token vector."""
         self._key, sub = jax.random.split(self._key)
         next_tokens, self._cache, self._lengths = self._decode_jit(
-            self.params, self._cache, self._lengths, self._tokens, sub)
+            self.params, self._cache, self._lengths, self._tokens, sub,
+            self._temps, self._topks, self._topps)
         self._tokens = next_tokens
         self._step_count += 1
         return np.asarray(jax.device_get(next_tokens))
@@ -387,16 +482,26 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         toks, self._cache, self._lengths, self._tokens = \
             self._decode_many_jit(self.params, self._cache, self._lengths,
-                                  self._tokens, sub, k=k)
+                                  self._tokens, sub, self._temps,
+                                  self._topks, self._topps, k=k)
         self._step_count += k
         return np.asarray(jax.device_get(toks))
 
     # -- continuous batching --------------------------------------------- #
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
-                       max_new_tokens: int = 32) -> List[List[int]]:
+                       max_new_tokens: int = 32,
+                       sampling: Any = None) -> List[List[int]]:
         """Offline API: all prompts through the continuous-batching loop;
-        slots are refilled as requests finish (no drain barrier)."""
+        slots are refilled as requests finish (no drain barrier).
+        `sampling`: None (engine default), one SamplingParams for all
+        prompts, or a per-prompt sequence."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            per_prompt = [sampling] * len(prompts)
+        else:
+            if len(sampling) != len(prompts):
+                raise ValueError('sampling list length != prompts')
+            per_prompt = list(sampling)
         results: Dict[int, List[int]] = {}
         pending = list(enumerate(prompts))[::-1]   # pop() takes req 0 first
         slots: Dict[int, _Slot] = {}
@@ -404,16 +509,16 @@ class Engine:
         while pending or slots:
             free = [s for s in range(self.cfg.batch_size)
                     if s not in slots]
-            wave: List[Tuple[int, Sequence[int]]] = []
+            wave: List[Tuple] = []
             meta: Dict[int, int] = {}
             while pending and free:
                 req_id, prompt = pending.pop()
                 slot_id = free.pop(0)
-                wave.append((slot_id, prompt))
+                wave.append((slot_id, prompt, per_prompt[req_id]))
                 meta[slot_id] = req_id
             if wave:
                 firsts = self.admit(wave)
-                for slot_id, prompt in wave:
+                for slot_id, prompt, _sp in wave:
                     slots[slot_id] = _Slot(meta[slot_id], len(prompt),
                                            [firsts[slot_id]],
                                            max_new_tokens)
@@ -503,7 +608,9 @@ class Engine:
             wave = []
             meta = {}
             while waiting and free:
-                prompt, max_new, out_q = waiting.popleft()
+                item = waiting.popleft()
+                prompt, max_new, out_q = item[0], item[1], item[2]
+                sp = item[3] if len(item) > 3 else None
                 try:
                     self._validate(prompt)
                 except Exception as e:  # noqa: BLE001
@@ -513,7 +620,7 @@ class Engine:
                         out_q.put(None)
                     continue
                 slot_id = free.pop(0)
-                wave.append((slot_id, prompt))
+                wave.append((slot_id, prompt, sp))
                 meta[slot_id] = (max_new, out_q)
             if wave:
                 try:
@@ -524,13 +631,13 @@ class Engine:
                     # any single wave. Reject the wave's clients and
                     # keep going.
                     logger.warning('admit failed, rejecting wave: %s', e)
-                    for _slot_id, _prompt in wave:
+                    for _slot_id, _prompt, _sp in wave:
                         _mn, out_q = meta[_slot_id]
                         if out_q is not None:
                             out_q.put(e)
                             out_q.put(None)
                     continue
-                for slot_id, prompt in wave:
+                for slot_id, prompt, _sp in wave:
                     first = firsts[slot_id]
                     max_new, out_q = meta[slot_id]
                     slots[slot_id] = _Slot(next_id, len(prompt), [first],

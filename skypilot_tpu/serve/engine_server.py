@@ -161,11 +161,24 @@ class ModelServer:
                         raise ValueError('prompt must be str or [int]')
                     max_new = int(req.get('max_new_tokens', 64))
                     stream = bool(req.get('stream', False))
+                    sampling = None
+                    if any(k in req for k in ('temperature', 'top_k',
+                                              'top_p')):
+                        # Unspecified fields keep the SERVER's defaults
+                        # (a request asking only for top_p must not
+                        # silently flip the temperature to greedy).
+                        sampling = engine_lib.SamplingParams(
+                            temperature=float(req.get(
+                                'temperature',
+                                server.engine.cfg.temperature)),
+                            top_k=int(req.get('top_k', 0)),
+                            top_p=float(req.get('top_p', 1.0)))
                 except (ValueError, json.JSONDecodeError) as e:
                     self._json(400, {'error': str(e)})
                     return
                 out_q: queue.Queue = queue.Queue()
-                server.request_queue.put((tokens, max_new, out_q))
+                server.request_queue.put(
+                    (tokens, max_new, out_q, sampling))
                 if stream:
                     self._stream_sse(out_q)
                     return

@@ -370,3 +370,91 @@ def test_expert_parallel_mixtral_engine(mixtral_model):
     prompts = [[3, 17, 99], [5, 9]]
     assert (ep.generate_batch(prompts, max_new_tokens=5)
             == single.generate_batch(prompts, max_new_tokens=5))
+
+
+# Per-request sampling -------------------------------------------------- #
+
+def test_per_request_sampling_topk1_is_greedy(model):
+    """top_k=1 at any temperature must reproduce the greedy sequence —
+    a deterministic pin on the top-k filter path."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    prompt = [3, 17, 99]
+    sp = engine_lib.SamplingParams(temperature=1.0, top_k=1)
+    [got] = eng.generate_batch([prompt], max_new_tokens=6, sampling=sp)
+    assert got == _ref_greedy(params, cfg, prompt, 6)
+
+
+def test_per_request_sampling_tiny_topp_is_greedy(model):
+    """top_p below the argmax's probability keeps only the argmax."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    prompt = [5, 9, 23]
+    sp = engine_lib.SamplingParams(temperature=0.7, top_p=1e-6)
+    [got] = eng.generate_batch([prompt], max_new_tokens=5, sampling=sp)
+    assert got == _ref_greedy(params, cfg, prompt, 5)
+
+
+def test_mixed_sampling_batch(model):
+    """Heterogeneous per-slot sampling in ONE batch: a greedy slot and a
+    top_k=1 sampled slot both produce their greedy sequences while
+    decoding together."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    prompts = [[3, 17, 99], [5, 9, 23, 41]]
+    sampling = [engine_lib.SamplingParams(temperature=0.0),
+                engine_lib.SamplingParams(temperature=1.3, top_k=1)]
+    got = eng.generate_batch(prompts, max_new_tokens=5,
+                             sampling=sampling)
+    for p, g in zip(prompts, got):
+        assert g == _ref_greedy(params, cfg, p, 5), p
+
+
+def test_sampling_with_temperature_varies_tokens(model):
+    """temperature>0 without filters actually samples (different seeds
+    give different outputs somewhere in a long-enough stream)."""
+    cfg, params = model
+    outs = []
+    for seed in (1, 2, 3):
+        eng = engine_lib.Engine(
+            cfg, params,
+            engine_lib.EngineConfig(batch_size=1, max_decode_len=64,
+                                    prefill_buckets=(8,)),
+            seed=seed)
+        sp = engine_lib.SamplingParams(temperature=2.0)
+        [out] = eng.generate_batch([[3, 17, 99]], max_new_tokens=8,
+                                   sampling=sp)
+        outs.append(tuple(out))
+    assert len(set(outs)) > 1
+
+
+def test_topp_mass_uses_full_distribution(model):
+    """The nucleus cut must be computed against TRUE probability mass:
+    with a near-flat distribution (high temperature) and top_p=0.95 the
+    whole top-64 candidate set stays live (a top-64-renormalized cumsum
+    would truncate to ~60 tokens and collapse diversity)."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=1, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    logits = jnp.zeros((1, cfg.vocab_size))   # flat: every p = 1/128
+    toks = set()
+    for i in range(200):
+        t = eng._sample(logits, jax.random.PRNGKey(i),
+                        jnp.asarray([1.0]), jnp.asarray([0]),
+                        jnp.asarray([0.95]))
+        toks.add(int(t[0]))
+    # True nucleus at p=0.95 over a flat 128-vocab = ~122 tokens; the
+    # top-64 candidate cap binds first, so all 64 candidates must be
+    # reachable. A top-64-renormalized cumsum keeps only ~61.
+    assert len(toks) > 45
