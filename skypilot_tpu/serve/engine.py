@@ -173,6 +173,12 @@ class Engine:
         self._temps = jnp.full((b,), self.cfg.temperature, jnp.float32)
         self._topks = jnp.zeros((b,), jnp.int32)
         self._topps = jnp.ones((b,), jnp.float32)
+        # Host-side mirror of per-slot temperatures: decides the STATIC
+        # sampling_on flag per dispatch and is reset when a slot
+        # finishes (the device row may stay stale — dead rows' samples
+        # are discarded host-side).
+        self._host_temps = np.full((b,), self.cfg.temperature,
+                                   np.float32)
         if mesh is not None:
             self._lengths = jax.device_put(self._lengths, repl)
             self._tokens = jax.device_put(self._tokens, repl)
@@ -187,9 +193,11 @@ class Engine:
 
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
+            static_argnames=('sampling_on',),
             out_shardings=out_s(repl, kv_ns))
         self._prefill_many_jit = jax.jit(
             functools.partial(self._prefill_many_impl, cfg=model_cfg),
+            static_argnames=('sampling_on',),
             out_shardings=out_s(repl, kv_ns))
         self._insert_jit = jax.jit(
             self._insert_impl, donate_argnums=(0,),
@@ -199,11 +207,11 @@ class Engine:
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
-            donate_argnums=(1,),
+            static_argnames=('sampling_on',), donate_argnums=(1,),
             out_shardings=out_s(repl, cache_ns, repl))
         self._decode_many_jit = jax.jit(
             functools.partial(self._decode_many_impl, cfg=model_cfg),
-            static_argnames=('k',), donate_argnums=(1,),
+            static_argnames=('k', 'sampling_on'), donate_argnums=(1,),
             out_shardings=out_s(repl, cache_ns, repl, repl))
 
     # -- device programs ------------------------------------------------ #
@@ -212,16 +220,23 @@ class Engine:
     _MAX_TOPK = 64
 
     def _sample(self, logits: jax.Array, key: jax.Array,
-                temps: jax.Array, topks: jax.Array,
-                topps: jax.Array) -> jax.Array:
+                temps: jax.Array, topks: jax.Array, topps: jax.Array,
+                sampling_on: bool) -> jax.Array:
         """Batched per-row sampling: logits [B, V], per-row temperature
-        (<=0 greedy), top-k (<=0 off) and top-p (>=1 off). One compiled
-        program regardless of the mix."""
+        (<=0 greedy), top-k (<=0 off) and top-p (>=1 off).
+
+        `sampling_on` is STATIC (host-tracked: engine slot bookkeeping
+        knows whether any live request samples): all-greedy batches —
+        the throughput/default-server case — compile to a pure argmax
+        program with no vocab-wide top_k/categorical at all; at most
+        two executables exist per step shape."""
         logits = logits.astype(jnp.float32)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if not sampling_on:
+            return greedy
+
         safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
         scaled = logits / safe_t
-
         kk = min(self._MAX_TOPK, scaled.shape[-1])
         vals, _ = jax.lax.top_k(scaled, kk)                   # [B, kk]
         k = jnp.clip(jnp.where(topks <= 0, kk, topks), 1, kk)
@@ -244,18 +259,18 @@ class Engine:
         needs_filter = ((topks > 0) | (topps < 1.0))[:, None]
         final = jnp.where(needs_filter & (scaled < thresh),
                           -jnp.inf, scaled)
-        sampled = jax.random.categorical(key, final,
-                                         axis=-1).astype(jnp.int32)
-        return jnp.where(temps <= 0, greedy, sampled)
+        s = jax.random.categorical(key, final,
+                                   axis=-1).astype(jnp.int32)
+        return jnp.where(temps <= 0, greedy, s)
 
     def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
-                      topp, cfg):
+                      topp, cfg, sampling_on):
         """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[0, true_len - 1]
         tok = self._sample(last[None], key, temp[None], topk[None],
-                           topp[None])[0]
+                           topp[None], sampling_on)[0]
         return tok, kv
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
@@ -276,7 +291,7 @@ class Engine:
         return new_cache, lengths, tokens, temps, topks, topps
 
     def _prefill_many_impl(self, params, tokens, true_lens, key,
-                           temps, topks, topps, cfg):
+                           temps, topks, topps, cfg, sampling_on):
         """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
         Returns (first_tokens [N], kv [L, N, S, KV, hd]). Rows are
         independent (causal attention; the MoE path pins a drop-free
@@ -285,7 +300,8 @@ class Engine:
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
-        toks = self._sample(last, key, temps, topks, topps)
+        toks = self._sample(last, key, temps, topks, topps,
+                            sampling_on)
         return toks, kv
 
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
@@ -307,21 +323,23 @@ class Engine:
         return new_cache, lengths, tokens, temps, topks, topps
 
     def _decode_impl(self, params, cache, lengths, tokens, key, temps,
-                     topks, topps, cfg):
+                     topks, topps, cfg, sampling_on):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-        next_tokens = self._sample(logits, key, temps, topks, topps)
+        next_tokens = self._sample(logits, key, temps, topks, topps,
+                                   sampling_on)
         return next_tokens, new_cache, lengths + 1
 
     def _decode_many_impl(self, params, cache, lengths, tokens, key,
-                          temps, topks, topps, k, cfg):
+                          temps, topks, topps, k, cfg, sampling_on):
         """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
         One dispatch + one host transfer per k tokens."""
         def body(carry, subkey):
             cache, lengths, tokens = carry
             logits, cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-            nt = self._sample(logits, subkey, temps, topks, topps)
+            nt = self._sample(logits, subkey, temps, topks, topps,
+                              sampling_on)
             return (cache, lengths + 1, nt), nt
 
         keys = jax.random.split(key, k)
@@ -377,13 +395,14 @@ class Engine:
         tok, kv = self._prefill_jit(
             self.params, jnp.asarray(padded), len(prompt), sub,
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-            jnp.float32(sp.top_p))
+            jnp.float32(sp.top_p), sampling_on=sp.temperature > 0)
         return int(tok), kv
 
     def insert(self, prefix_kv: Any, slot: int, length: int,
                first_token: int,
                sampling: Optional[SamplingParams] = None) -> None:
         sp = self._sampling_or_default(sampling)
+        self._host_temps[slot] = sp.temperature
         (self._cache, self._lengths, self._tokens, self._temps,
          self._topks, self._topps) = self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
@@ -449,7 +468,10 @@ class Engine:
                 self._key, sub = jax.random.split(self._key)
                 toks, kv = self._prefill_many_jit(
                     self.params, jnp.asarray(padded),
-                    jnp.asarray(true_lens), sub, temps, topks, topps)
+                    jnp.asarray(true_lens), sub, temps, topks, topps,
+                    sampling_on=any(sp.temperature > 0
+                                    for _s, _p, sp in chunk))
+                self._host_temps[slots] = np.asarray(temps)
                 (self._cache, self._lengths, self._tokens, self._temps,
                  self._topks, self._topps) = self._insert_many_jit(
                     self._cache, kv, jnp.asarray(slots),
@@ -470,7 +492,8 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         next_tokens, self._cache, self._lengths = self._decode_jit(
             self.params, self._cache, self._lengths, self._tokens, sub,
-            self._temps, self._topks, self._topps)
+            self._temps, self._topks, self._topps,
+            sampling_on=bool((self._host_temps > 0).any()))
         self._tokens = next_tokens
         self._step_count += 1
         return np.asarray(jax.device_get(next_tokens))
@@ -483,7 +506,9 @@ class Engine:
         toks, self._cache, self._lengths, self._tokens = \
             self._decode_many_jit(self.params, self._cache, self._lengths,
                                   self._tokens, sub, self._temps,
-                                  self._topks, self._topps, k=k)
+                                  self._topks, self._topps, k=k,
+                                  sampling_on=bool(
+                                      (self._host_temps > 0).any()))
         self._step_count += k
         return np.asarray(jax.device_get(toks))
 
@@ -571,6 +596,10 @@ class Engine:
             if slot.out_queue is not None:
                 slot.out_queue.put(None)        # end-of-stream
             del slots[slot_id]
+            # Freed slot no longer pins the sampling executable: one
+            # sampled request must not disable the all-greedy fast
+            # path for the rest of the process lifetime.
+            self._host_temps[slot_id] = self.cfg.temperature
 
     # -- online loop (used by the model server) -------------------------- #
 

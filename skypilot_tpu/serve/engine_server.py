@@ -173,7 +173,8 @@ class ModelServer:
                                 server.engine.cfg.temperature)),
                             top_k=int(req.get('top_k', 0)),
                             top_p=float(req.get('top_p', 1.0)))
-                except (ValueError, json.JSONDecodeError) as e:
+                except (ValueError, TypeError,
+                        json.JSONDecodeError) as e:
                     self._json(400, {'error': str(e)})
                     return
                 out_q: queue.Queue = queue.Queue()

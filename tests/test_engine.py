@@ -452,9 +452,25 @@ def test_topp_mass_uses_full_distribution(model):
     for i in range(200):
         t = eng._sample(logits, jax.random.PRNGKey(i),
                         jnp.asarray([1.0]), jnp.asarray([0]),
-                        jnp.asarray([0.95]))
+                        jnp.asarray([0.95]), sampling_on=True)
         toks.add(int(t[0]))
     # True nucleus at p=0.95 over a flat 128-vocab = ~122 tokens; the
     # top-64 candidate cap binds first, so all 64 candidates must be
     # reachable. A top-64-renormalized cumsum keeps only ~61.
     assert len(toks) > 45
+
+
+def test_sampled_slot_releases_greedy_fast_path(model):
+    """After a sampled request finishes, the engine's host tracking must
+    flip the static sampling_on flag back off (one sampled request must
+    not pin the expensive sampling executable forever)."""
+    cfg, params = model
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8,)))
+    sp = engine_lib.SamplingParams(temperature=1.0, top_k=1)
+    eng.generate_batch([[3, 17, 99]], max_new_tokens=3, sampling=sp)
+    assert not (eng._host_temps > 0).any()
+    eng.generate_batch([[5, 9]], max_new_tokens=3)   # greedy again
+    assert not (eng._host_temps > 0).any()
