@@ -62,6 +62,11 @@ class EngineConfig:
     # the step time (ops/quant.py). Applied once at engine init via the
     # model module's quantize_params.
     quantize: Optional[str] = None
+    # Candidate pool for top-k / nucleus filtering: top_k above this is
+    # REJECTED (validate_sampling), never silently clamped; top_p is
+    # exact whenever the nucleus fits in this many candidates. Larger
+    # pools cost a wider per-step lax.top_k over the vocab.
+    max_topk: int = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,8 +77,11 @@ class SamplingParams:
 
     temperature <= 0 is greedy. top_k <= 0 and top_p >= 1 disable the
     respective filters. Nucleus/top-k candidate selection is computed
-    over the top-64 logits (exact whenever the nucleus fits in 64
-    candidates — the practical case)."""
+    over the top-`EngineConfig.max_topk` logits (default 64): top_k
+    above the pool is rejected loudly (Engine.validate_sampling), and
+    top_p is exact whenever the nucleus fits in the pool — the
+    practical case (see tests/test_sampling_quality.py for the
+    distributional guarantee and the fallback behavior)."""
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
@@ -216,8 +224,22 @@ class Engine:
 
     # -- device programs ------------------------------------------------ #
 
-    # Candidate pool for top-k / nucleus filtering (see SamplingParams).
-    _MAX_TOPK = 64
+    @property
+    def _MAX_TOPK(self) -> int:
+        return self.cfg.max_topk
+
+    def validate_sampling(self, sp: SamplingParams) -> None:
+        """Raise ValueError for sampling params the engine cannot honor
+        EXACTLY — loud at the boundary, never a silent clamp."""
+        if sp.top_k > self.cfg.max_topk:
+            raise ValueError(
+                f'top_k={sp.top_k} exceeds the engine candidate pool '
+                f'({self.cfg.max_topk}); raise EngineConfig.max_topk '
+                'to serve larger top_k')
+        if sp.top_p <= 0.0:
+            raise ValueError(
+                f'top_p must be positive, got {sp.top_p} '
+                '(>= 1 disables the nucleus filter)')
 
     def _sample(self, logits: jax.Array, key: jax.Array,
                 temps: jax.Array, topks: jax.Array, topps: jax.Array,
@@ -380,6 +402,7 @@ class Engine:
     def _sampling_or_default(self, sampling) -> SamplingParams:
         if sampling is None:
             return SamplingParams(temperature=self.cfg.temperature)
+        self.validate_sampling(sampling)
         return sampling
 
     def prefill(self, prompt: Sequence[int],
@@ -642,6 +665,8 @@ class Engine:
                 sp = item[3] if len(item) > 3 else None
                 try:
                     self._validate(prompt)
+                    if sp is not None:
+                        self.validate_sampling(sp)
                 except Exception as e:  # noqa: BLE001
                     logger.warning('rejecting request: %s', e)
                     if out_q is not None:

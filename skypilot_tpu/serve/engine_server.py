@@ -2,23 +2,33 @@
 
 The reference's serve replicas run arbitrary user commands (vLLM,
 JetStream, TGI — llm/mixtral/serve.yaml); readiness is probed over HTTP
-(reference sky/serve/replica_managers.py:1026-1130). This server is the
-in-framework equivalent workload: start it as the `run:` command of a
-service task and point `readiness_probe: /health` at it.
+(reference sky/serve/replica_managers.py:1026-1130) and clients speak
+the OpenAI API (reference llm/mixtral/serve.yaml:37-40 probes
+/v1/chat/completions). This server is the in-framework equivalent
+workload: start it as the `run:` command of a service task and point
+`readiness_probe: /health` (or /v1/models) at it.
 
 Endpoints:
-    GET  /health              -> 200 once the engine compiled a step
-    POST /generate            -> {"prompt": [ids] | "text", "max_new_tokens": N}
-                                 returns {"tokens": [...], "text": "..."}
-                                 With "stream": true -> Server-Sent Events:
-                                 one `data: {"token": t, "text": ...}` per
-                                 generated token as the engine emits it
-                                 (JetStream-style token streaming,
-                                 reference examples/tpu/v6e/README.md:104),
-                                 ending with `data: [DONE]`.
+    GET  /health               -> 200 once the engine compiled a step
+    GET  /v1/models            -> OpenAI model listing
+    POST /generate             -> {"prompt": [ids] | "text",
+                                  "max_new_tokens": N}
+                                  returns {"tokens": [...], "text": ...}
+    POST /v1/completions       -> OpenAI text completion (prompt as str
+                                  or [ids]); "stream": true for SSE
+    POST /v1/chat/completions  -> OpenAI chat (messages), rendered
+                                  through the checkpoint's chat template
+                                  when it ships one; SSE streaming
 
-Tokenization is byte-level (UTF-8 byte + 3 reserved ids) so demos work
-without shipping a tokenizer asset; real deployments pass token ids.
+All streaming uses Server-Sent Events ending with `data: [DONE]`,
+tokens emitted the moment the engine's decode loop produces them.
+
+Tokenization: with --hf-model the checkpoint's OWN tokenizer is loaded
+(serve/tokenizer.py); if the checkpoint ships no tokenizer asset, text
+prompts are REJECTED (400) rather than garbled through a byte fallback
+— ids 3..258 are arbitrary BPE tokens in a trained vocabulary. The
+byte-level tokenizer remains the default for the random-weight demo
+presets, where no real vocabulary exists.
 """
 from __future__ import annotations
 
@@ -27,7 +37,8 @@ import http.server
 import json
 import queue
 import threading
-from typing import List, Optional
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -35,21 +46,22 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import mixtral
 from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import tokenizer as tokenizer_lib
 
 logger = sky_logging.init_logger(__name__)
 
-PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
-_BYTE_OFFSET = 3
+PAD_ID, BOS_ID, EOS_ID = (tokenizer_lib.PAD_ID, tokenizer_lib.BOS_ID,
+                          tokenizer_lib.EOS_ID)
+
+_BYTE_TOKENIZER = tokenizer_lib.ByteTokenizer()
 
 
 def encode_text(text: str) -> List[int]:
-    return [BOS_ID] + [b + _BYTE_OFFSET for b in text.encode('utf-8')]
+    return _BYTE_TOKENIZER.encode(text)
 
 
-def decode_tokens(tokens: List[int]) -> str:
-    data = bytes(t - _BYTE_OFFSET for t in tokens
-                 if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
-    return data.decode('utf-8', errors='replace')
+def decode_tokens(tokens: Sequence[int]) -> str:
+    return _BYTE_TOKENIZER.decode(tokens)
 
 
 # name -> (config factory, model module implementing the serving
@@ -63,7 +75,28 @@ MODEL_PRESETS = {
 }
 
 
+class _BadRequest(ValueError):
+    pass
+
+
 class ModelServer:
+
+    @classmethod
+    def from_engine(cls, engine: 'engine_lib.Engine', port: int,
+                    tokenizer: Optional[Any] = _BYTE_TOKENIZER,
+                    model_name: str = 'model') -> 'ModelServer':
+        """Wrap an already-built Engine (tests / embedding use): the
+        HTTP surface without __init__'s model construction."""
+        srv = cls.__new__(cls)
+        srv.engine = engine
+        srv.tokenizer = tokenizer
+        srv.model_name = model_name
+        srv.port = port
+        srv.ready = threading.Event()
+        srv.request_queue = queue.Queue()
+        srv.stop = threading.Event()
+        srv._httpd = None
+        return srv
 
     def __init__(self, model: str = 'tiny', port: int = 8000,
                  batch_size: int = 8, max_decode_len: int = 1024,
@@ -87,15 +120,23 @@ class ModelServer:
             # Llama-3 vocab uses id 2 as an ordinary BPE token).
             if hf_eos is not None:
                 eos_id = hf_eos
+            self.tokenizer = tokenizer_lib.load_tokenizer(hf_model)
+            self.model_name = hf_model
+            if self.tokenizer is None:
+                logger.warning(
+                    'checkpoint %s ships no tokenizer asset: text '
+                    'prompts will be rejected (pass token ids)',
+                    hf_model)
         else:
             cfg_factory, model_module = MODEL_PRESETS[model]
             cfg = cfg_factory()
+            self.tokenizer = _BYTE_TOKENIZER
+            self.model_name = model
         mesh = None
         if tp > 1:
             from skypilot_tpu.parallel import mesh as mesh_lib
             mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=tp),
                                       devices=jax.devices()[:tp])
-        # Byte-level vocab must fit.
         self.engine = engine_lib.Engine(
             cfg, params, model=model_module, mesh=mesh,
             engine_cfg=engine_lib.EngineConfig(
@@ -117,6 +158,44 @@ class ModelServer:
         self.ready.set()
         logger.info('engine warmed up; serving on :%d', self.port)
 
+    # -- request parsing ---------------------------------------------- #
+
+    def _encode_prompt(self, prompt: Any) -> List[int]:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise _BadRequest(
+                    'this checkpoint has no tokenizer: pass token ids '
+                    '(a string prompt cannot be encoded faithfully)')
+            return self.tokenizer.encode(prompt)
+        if isinstance(prompt, list) and all(
+                isinstance(t, int) or (isinstance(t, float)
+                                       and float(t).is_integer())
+                for t in prompt):
+            return [int(t) for t in prompt]
+        raise _BadRequest('prompt must be a string or a list of ints')
+
+    def _sampling_from(self, req: Dict[str, Any]
+                       ) -> Optional[engine_lib.SamplingParams]:
+        if not any(k in req for k in ('temperature', 'top_k', 'top_p')):
+            return None
+        # Unspecified fields keep the SERVER's defaults (a request
+        # asking only for top_p must not silently flip the temperature
+        # to greedy).
+        sp = engine_lib.SamplingParams(
+            temperature=float(req.get('temperature',
+                                      self.engine.cfg.temperature)),
+            top_k=int(req.get('top_k', 0)),
+            top_p=float(req.get('top_p', 1.0)))
+        # Loud validation at the API boundary (engine re-validates):
+        # silently clamping top_k>64 to 64 surprised clients.
+        self.engine.validate_sampling(sp)
+        return sp
+
+    def _decode_text(self, toks: List[int]) -> str:
+        return self.tokenizer.decode(toks) if self.tokenizer else ''
+
+    # -- server ------------------------------------------------------- #
+
     def serve_forever(self) -> None:
         server = self
 
@@ -136,53 +215,59 @@ class ModelServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _error(self, code: int, msg: str) -> None:
+                # OpenAI-style error envelope (also fine for /generate).
+                self._json(code, {'error': {'message': msg,
+                                            'type': 'invalid_request_error'}
+                                  if self.path.startswith('/v1/')
+                                  else msg})
+
             def do_GET(self):
                 if self.path == '/health':
                     if server.ready.is_set():
                         self._json(200, {'status': 'ok'})
                     else:
                         self._json(503, {'status': 'warming up'})
+                elif self.path == '/v1/models':
+                    self._json(200, {
+                        'object': 'list',
+                        'data': [{'id': server.model_name,
+                                  'object': 'model',
+                                  'owned_by': 'skypilot-tpu'}]})
                 else:
-                    self._json(404, {'error': 'not found'})
+                    self._error(404, 'not found')
 
             def do_POST(self):
-                if self.path != '/generate':
-                    self._json(404, {'error': 'not found'})
+                route = {
+                    '/generate': self._handle_generate,
+                    '/v1/completions': self._handle_completions,
+                    '/v1/chat/completions': self._handle_chat,
+                }.get(self.path)
+                if route is None:
+                    self._error(404, 'not found')
                     return
                 length = int(self.headers.get('Content-Length', 0))
                 try:
                     req = json.loads(self.rfile.read(length) or b'{}')
-                    prompt = req.get('prompt')
-                    if isinstance(prompt, str):
-                        tokens = encode_text(prompt)
-                    elif isinstance(prompt, list):
-                        tokens = [int(t) for t in prompt]
-                    else:
-                        raise ValueError('prompt must be str or [int]')
-                    max_new = int(req.get('max_new_tokens', 64))
-                    stream = bool(req.get('stream', False))
-                    sampling = None
-                    if any(k in req for k in ('temperature', 'top_k',
-                                              'top_p')):
-                        # Unspecified fields keep the SERVER's defaults
-                        # (a request asking only for top_p must not
-                        # silently flip the temperature to greedy).
-                        sampling = engine_lib.SamplingParams(
-                            temperature=float(req.get(
-                                'temperature',
-                                server.engine.cfg.temperature)),
-                            top_k=int(req.get('top_k', 0)),
-                            top_p=float(req.get('top_p', 1.0)))
-                except (ValueError, TypeError,
+                    if not isinstance(req, dict):
+                        raise _BadRequest('request body must be a JSON '
+                                          'object')
+                    route(req)
+                except (_BadRequest, ValueError, TypeError, KeyError,
                         json.JSONDecodeError) as e:
-                    self._json(400, {'error': str(e)})
-                    return
+                    self._error(400, str(e))
+
+            # -- request execution ------------------------------------ #
+
+            def _enqueue(self, tokens: List[int], max_new: int,
+                         sampling) -> 'queue.Queue':
                 out_q: queue.Queue = queue.Queue()
                 server.request_queue.put(
                     (tokens, max_new, out_q, sampling))
-                if stream:
-                    self._stream_sse(out_q)
-                    return
+                return out_q
+
+            def _collect(self, out_q: 'queue.Queue'
+                         ) -> Tuple[List[int], Optional[Exception]]:
                 toks: List[int] = []
                 error = None
                 while True:
@@ -193,26 +278,127 @@ class ModelServer:
                         error = item
                         continue
                     toks.append(item)
+                return toks, error
+
+            # -- /generate (legacy ids+text API) ---------------------- #
+
+            def _handle_generate(self, req) -> None:
+                tokens = server._encode_prompt(req.get('prompt'))
+                max_new = int(req.get('max_new_tokens', 64))
+                sampling = server._sampling_from(req)
+                out_q = self._enqueue(tokens, max_new, sampling)
+                if bool(req.get('stream', False)):
+                    # Final 'text'-only frame carries any tail the
+                    # incremental detokenizer held back (a stream
+                    # ending mid multi-byte character).
+                    self._stream_sse(
+                        out_q,
+                        lambda tok, delta: {'token': tok, 'text': delta})
+                    return
+                toks, error = self._collect(out_q)
                 if error is not None:
-                    self._json(400, {'error': str(error)})
+                    self._error(400, str(error))
                     return
                 self._json(200, {'tokens': toks,
-                                 'text': decode_tokens(toks)})
+                                 'text': server._decode_text(toks)})
+
+            # -- OpenAI-compatible endpoints -------------------------- #
+
+            def _handle_completions(self, req) -> None:
+                tokens = server._encode_prompt(req.get('prompt'))
+                self._run_openai(req, tokens, chat=False)
+
+            def _handle_chat(self, req) -> None:
+                messages = req.get('messages')
+                if (not isinstance(messages, list) or not messages
+                        or not all(isinstance(m, dict)
+                                   for m in messages)):
+                    raise _BadRequest(
+                        'messages must be a non-empty list of '
+                        '{role, content} objects')
+                if server.tokenizer is None:
+                    raise _BadRequest(
+                        'this checkpoint has no tokenizer: chat '
+                        'requests need one (serve with a checkpoint '
+                        'directory that ships tokenizer assets)')
+                tokens = server.tokenizer.apply_chat_template(messages)
+                self._run_openai(req, tokens, chat=True)
+
+            def _run_openai(self, req, tokens: List[int],
+                            chat: bool) -> None:
+                max_new = int(req.get('max_tokens',
+                                      req.get('max_new_tokens', 64)))
+                if max_new <= 0:
+                    raise _BadRequest('max_tokens must be positive')
+                sampling = server._sampling_from(req)
+                stop = req.get('stop')
+                if isinstance(stop, str):
+                    stop = [stop]
+                if stop is not None and not (
+                        isinstance(stop, list)
+                        and all(isinstance(s, str) for s in stop)):
+                    raise _BadRequest('stop must be a string or list '
+                                      'of strings')
+                rid = (f'chatcmpl-{int(time.time()*1000)}' if chat
+                       else f'cmpl-{int(time.time()*1000)}')
+                created = int(time.time())
+                out_q = self._enqueue(tokens, max_new, sampling)
+                if bool(req.get('stream', False)):
+                    self._stream_openai(out_q, rid, created, chat, stop,
+                                        max_new)
+                    return
+                toks, error = self._collect(out_q)
+                if error is not None:
+                    self._error(400, str(error))
+                    return
+                text = server._decode_text(toks)
+                finish = 'length' if len(toks) >= max_new else 'stop'
+                if stop:
+                    cut = min((text.find(s) for s in stop
+                               if text.find(s) >= 0), default=-1)
+                    if cut >= 0:
+                        text = text[:cut]
+                        finish = 'stop'
+                if chat:
+                    choice = {'index': 0,
+                              'message': {'role': 'assistant',
+                                          'content': text},
+                              'finish_reason': finish}
+                    obj = 'chat.completion'
+                else:
+                    choice = {'index': 0, 'text': text,
+                              'logprobs': None, 'finish_reason': finish}
+                    obj = 'text_completion'
+                self._json(200, {
+                    'id': rid, 'object': obj, 'created': created,
+                    'model': server.model_name, 'choices': [choice],
+                    'usage': {'prompt_tokens': len(tokens),
+                              'completion_tokens': len(toks),
+                              'total_tokens': len(tokens) + len(toks)}})
+
+            # -- streaming -------------------------------------------- #
 
             def _chunk(self, data: bytes) -> None:
                 self.wfile.write(f'{len(data):x}\r\n'.encode() + data
                                  + b'\r\n')
                 self.wfile.flush()
 
-            def _stream_sse(self, out_q: 'queue.Queue') -> None:
-                """Emit each token the moment the engine's decode loop
-                produces it — the engine's queue API was built for this;
-                round 1 only ever drained it at the end."""
+            def _sse_headers(self) -> None:
                 self.send_response(200)
                 self.send_header('Content-Type', 'text/event-stream')
                 self.send_header('Cache-Control', 'no-cache')
                 self.send_header('Transfer-Encoding', 'chunked')
                 self.end_headers()
+
+            def _stream_sse(self, out_q: 'queue.Queue',
+                            make_payload) -> None:
+                """Emit each token the moment the engine's decode loop
+                produces it. `make_payload(token, text_delta)` builds
+                the per-event JSON body; detokenization is incremental
+                (BPE tokens don't map 1:1 to text)."""
+                self._sse_headers()
+                dec = (tokenizer_lib.StreamDecoder(server.tokenizer)
+                       if server.tokenizer else None)
                 try:
                     while True:
                         item = out_q.get()
@@ -221,10 +407,18 @@ class ModelServer:
                         if isinstance(item, Exception):
                             payload = {'error': str(item)}
                         else:
-                            payload = {'token': item,
-                                       'text': decode_tokens([item])}
-                        self._chunk(b'data: ' + json.dumps(payload).encode()
+                            delta = dec.push(item) if dec else ''
+                            payload = make_payload(item, delta)
+                        self._chunk(b'data: '
+                                    + json.dumps(payload).encode()
                                     + b'\n\n')
+                    if dec is not None:
+                        tail = dec.flush()
+                        if tail:
+                            self._chunk(b'data: '
+                                        + json.dumps({'text': tail}
+                                                     ).encode()
+                                        + b'\n\n')
                     self._chunk(b'data: [DONE]\n\n')
                     self._chunk(b'')  # terminating 0-length chunk
                 except OSError:
@@ -232,6 +426,104 @@ class ModelServer:
                     # ConnectionReset / other socket errors are all
                     # OSError); the engine finishes into the orphaned
                     # queue harmlessly.
+                    pass
+
+            def _stream_openai(self, out_q: 'queue.Queue', rid: str,
+                               created: int, chat: bool,
+                               stop: Optional[List[str]],
+                               max_new: int) -> None:
+                """OpenAI SSE chunk framing. Stop sequences are matched
+                host-side on the cumulative text; text that could still
+                be the PREFIX of a stop string is held back (a stop
+                string spanning two deltas must not leak its first
+                half), so stream and non-stream agree. On a match the
+                stream ends early (the engine finishes into the
+                orphaned queue)."""
+                self._sse_headers()
+                obj = 'chat.completion.chunk' if chat else 'text_completion'
+
+                def frame(delta_text: Optional[str], finish) -> bytes:
+                    if chat:
+                        delta = ({'content': delta_text}
+                                 if delta_text is not None else {})
+                        choice = {'index': 0, 'delta': delta,
+                                  'finish_reason': finish}
+                    else:
+                        choice = {'index': 0, 'text': delta_text or '',
+                                  'logprobs': None,
+                                  'finish_reason': finish}
+                    return b'data: ' + json.dumps(
+                        {'id': rid, 'object': obj, 'created': created,
+                         'model': server.model_name,
+                         'choices': [choice]}).encode() + b'\n\n'
+
+                dec = (tokenizer_lib.StreamDecoder(server.tokenizer)
+                       if server.tokenizer else None)
+                hold = max((len(s) for s in stop), default=0) - 1 \
+                    if stop else 0
+                pending = ''
+                n_tokens = 0
+                stopped = False
+
+                def stop_cut(text: str) -> int:
+                    return min((text.find(s) for s in stop
+                                if text.find(s) >= 0), default=-1)
+
+                try:
+                    if chat:
+                        # Role announcement chunk (OpenAI convention).
+                        self._chunk(b'data: ' + json.dumps(
+                            {'id': rid, 'object': obj,
+                             'created': created,
+                             'model': server.model_name,
+                             'choices': [{'index': 0,
+                                          'delta': {'role': 'assistant'},
+                                          'finish_reason': None}]}
+                        ).encode() + b'\n\n')
+                    while True:
+                        item = out_q.get()
+                        if item is None:
+                            break
+                        if isinstance(item, Exception):
+                            self._chunk(b'data: ' + json.dumps(
+                                {'error': str(item)}).encode()
+                                + b'\n\n')
+                            continue
+                        n_tokens += 1
+                        delta = dec.push(item) if dec else ''
+                        if stop:
+                            pending += delta
+                            cut = stop_cut(pending)
+                            if cut >= 0:
+                                if cut > 0:
+                                    self._chunk(frame(pending[:cut],
+                                                      None))
+                                stopped = True
+                                break
+                            # Emit all but the last `hold` chars: the
+                            # held tail could still start a stop match.
+                            n_emit = len(pending) - hold
+                            if n_emit > 0:
+                                self._chunk(frame(pending[:n_emit],
+                                                  None))
+                                pending = pending[n_emit:]
+                        elif delta or not dec:
+                            self._chunk(frame(delta, None))
+                    if not stopped:
+                        tail = dec.flush() if dec else ''
+                        pending += tail
+                        cut = stop_cut(pending) if stop else -1
+                        if cut >= 0:
+                            pending = pending[:cut]
+                            stopped = True
+                        if pending:
+                            self._chunk(frame(pending, None))
+                    finish = ('length' if n_tokens >= max_new
+                              and not stopped else 'stop')
+                    self._chunk(frame(None, finish))
+                    self._chunk(b'data: [DONE]\n\n')
+                    self._chunk(b'')
+                except OSError:
                     pass
 
         class ThreadingServer(http.server.ThreadingHTTPServer):
@@ -281,7 +573,9 @@ def main() -> None:
     parser.add_argument('--hf-model', default=None,
                         help='path to a HuggingFace Llama or Mixtral '
                              'checkpoint (auto-detected, converted via '
-                             'models/hf_convert.py; overrides --model)')
+                             'models/hf_convert.py; overrides --model; '
+                             'loads the checkpoint tokenizer for the '
+                             'text/chat endpoints)')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,

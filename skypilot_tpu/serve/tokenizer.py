@@ -1,0 +1,179 @@
+"""Tokenizers for the model server: real HF tokenizer or byte fallback.
+
+The reference's serving recipes run vLLM/JetStream, which load the
+checkpoint's own tokenizer and expose text endpoints (reference
+llm/mixtral/serve.yaml:8,37-40 probes /v1/chat/completions). Here the
+same contract lives in-framework: `load_tokenizer(checkpoint_dir)`
+returns the checkpoint's BPE tokenizer (via `tokenizers` /
+transformers' AutoTokenizer, both shipped with transformers), and the
+byte-level `ByteTokenizer` remains the zero-asset fallback for demo
+presets with random weights, where no real vocabulary exists anyway.
+
+Streaming uses `StreamDecoder`: BPE tokens do not map 1:1 to text
+(a multi-byte UTF-8 character or a leading-space marker can span token
+boundaries), so per-token decode emits the SUFFIX of the cumulative
+decode instead of decoding each id in isolation.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+PAD_ID, BOS_ID, EOS_ID = 0, 1, 2
+_BYTE_OFFSET = 3
+
+
+class ByteTokenizer:
+    """UTF-8 bytes + 3 reserved ids — the no-asset demo tokenizer.
+
+    Only meaningful against models whose vocabulary was never trained
+    (the `tiny`/preset servers with random weights); a real checkpoint
+    must use its own tokenizer (ids 3..258 are arbitrary BPE tokens in
+    a trained vocab)."""
+
+    name = 'byte'
+    eos_id = EOS_ID
+
+    def encode(self, text: str) -> List[int]:
+        return [BOS_ID] + [b + _BYTE_OFFSET for b in text.encode('utf-8')]
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        data = bytes(t - _BYTE_OFFSET for t in tokens
+                     if _BYTE_OFFSET <= t < _BYTE_OFFSET + 256)
+        return data.decode('utf-8', errors='replace')
+
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
+        return self.encode(generic_chat_text(messages))
+
+
+class HFTokenizer:
+    """A checkpoint's own tokenizer (tokenizer.json / AutoTokenizer).
+
+    Prefers transformers' AutoTokenizer (knows special tokens, BOS
+    conventions, and the checkpoint's chat template); falls back to the
+    raw `tokenizers.Tokenizer` when only tokenizer.json exists."""
+
+    def __init__(self, path: str):
+        self.name = os.path.basename(os.path.normpath(path))
+        self._auto = None
+        self._raw = None
+        try:
+            import transformers
+            self._auto = transformers.AutoTokenizer.from_pretrained(path)
+        except Exception as e:  # noqa: BLE001 — fall back to raw
+            logger.debug('AutoTokenizer failed for %s: %s', path, e)
+            from tokenizers import Tokenizer
+            self._raw = Tokenizer.from_file(
+                os.path.join(path, 'tokenizer.json'))
+        self.eos_id = self._find_eos(path)
+
+    def _find_eos(self, path: str) -> Optional[int]:
+        if self._auto is not None and self._auto.eos_token_id is not None:
+            return int(self._auto.eos_token_id)
+        cfg_path = os.path.join(path, 'tokenizer_config.json')
+        if self._raw is not None and os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                eos_tok = json.load(f).get('eos_token')
+            if isinstance(eos_tok, dict):
+                eos_tok = eos_tok.get('content')
+            if eos_tok:
+                eid = self._raw.token_to_id(eos_tok)
+                if eid is not None:
+                    return int(eid)
+        return None
+
+    def encode(self, text: str) -> List[int]:
+        if self._auto is not None:
+            return list(self._auto.encode(text))
+        return list(self._raw.encode(text).ids)
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        toks = list(int(t) for t in tokens)
+        if self._auto is not None:
+            return self._auto.decode(toks, skip_special_tokens=True)
+        return self._raw.decode(toks)
+
+    def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
+        """Token ids for a chat, ready to generate the assistant turn.
+        Uses the checkpoint's own jinja template when it ships one
+        (Llama-3-Instruct etc.); otherwise a generic role-tagged
+        transcript."""
+        if self._auto is not None and getattr(
+                self._auto, 'chat_template', None):
+            try:
+                return list(self._auto.apply_chat_template(
+                    list(messages), add_generation_prompt=True))
+            except Exception as e:  # noqa: BLE001 — template quirk
+                logger.warning('chat template failed (%s); using '
+                               'generic transcript', e)
+        return self.encode(generic_chat_text(messages))
+
+
+def generic_chat_text(messages: Sequence[dict]) -> str:
+    """Role-tagged transcript for tokenizers without a chat template."""
+    lines = [f"{m.get('role', 'user')}: {m.get('content', '')}"
+             for m in messages]
+    return '\n'.join(lines) + '\nassistant:'
+
+
+def load_tokenizer(path: Optional[str]):
+    """The checkpoint's tokenizer, or None when the directory ships no
+    tokenizer asset (callers must then reject text requests rather than
+    garble them through the byte fallback)."""
+    if path is None:
+        return None
+    has_asset = any(
+        os.path.exists(os.path.join(path, f))
+        for f in ('tokenizer.json', 'tokenizer_config.json',
+                  'tokenizer.model'))
+    if not has_asset:
+        return None
+    try:
+        return HFTokenizer(path)
+    except Exception as e:  # noqa: BLE001 — corrupt asset
+        logger.warning('failed to load tokenizer from %s: %s', path, e)
+        return None
+
+
+class StreamDecoder:
+    """Incremental detokenizer for SSE streams: emits the new SUFFIX of
+    the decode on each token, holding back while the tail is an
+    incomplete UTF-8 sequence (U+FFFD from errors='replace').
+
+    Uses the prefix-offset scheme (as in TGI/vLLM): only a bounded
+    trailing window of ids is re-decoded per push — the window resets
+    every time text is emitted — so a long stream costs O(1) decodes
+    per token, not O(n) (cumulative re-decode made streaming O(n^2)
+    in generation length)."""
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._prefix = 0    # start of the decode window
+        self._read = 0      # ids whose text has been emitted
+
+    def _delta(self, final: bool) -> str:
+        prev = self._tok.decode(self._ids[self._prefix:self._read])
+        text = self._tok.decode(self._ids[self._prefix:])
+        # Hold back a trailing replacement char mid-stream: the final
+        # token usually ends part-way through a multi-byte character
+        # that the next token completes. On flush, emit as-is.
+        if not final and (text.endswith('�')
+                          or len(text) <= len(prev)):
+            return ''
+        delta = text[len(prev):]
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return delta
+
+    def push(self, token: int) -> str:
+        self._ids.append(int(token))
+        return self._delta(final=False)
+
+    def flush(self) -> str:
+        return self._delta(final=True)

@@ -22,21 +22,17 @@ def _free_port() -> int:
 @pytest.fixture(scope='module')
 def server():
     port = _free_port()
-    srv = engine_server.ModelServer.__new__(engine_server.ModelServer)
     cfg = llama.LlamaConfig(
         vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         ffn_dim=128, max_seq_len=256, rope_theta=10000.0,
         dtype=jnp.float32, remat=False, use_flash_attention=False)
-    srv.engine = engine_lib.Engine(
-        cfg, engine_cfg=engine_lib.EngineConfig(
-            batch_size=2, max_decode_len=64, prefill_buckets=(16, 64),
-            eos_id=engine_server.EOS_ID))
-    srv.port = port
-    srv.ready = threading.Event()
-    import queue
-    srv.request_queue = queue.Queue()
-    srv.stop = threading.Event()
-    srv._httpd = None
+    srv = engine_server.ModelServer.from_engine(
+        engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=2, max_decode_len=64,
+                prefill_buckets=(16, 64),
+                eos_id=engine_server.EOS_ID)),
+        port)
     # Surface a crashed server thread instead of letting later tests die
     # on an opaque connection error (the module fixture used to discard
     # ready.wait()'s return — a slow/contended compile or a warmup crash
