@@ -320,10 +320,23 @@ def jobs():
                    'provisioned cluster (survives this machine).')
 @click.option('--yes', '-y', is_flag=True)
 def jobs_launch(entrypoint, name, env, controller, yes):
+    from skypilot_tpu import dag as dag_lib
     from skypilot_tpu.jobs import core as jobs_core
-    task = _load_task(entrypoint, env, {})
-    if name:
-        task.name = name
+    if os.path.isfile(entrypoint):
+        # One parse for single tasks AND `---`-separated train->eval
+        # pipelines (tasks run sequentially with per-task recovery,
+        # jobs/controller.py).
+        dag = dag_lib.from_yaml(entrypoint, _parse_env(env) or None)
+        if len(dag.tasks) == 1:
+            task = dag.tasks[0]
+            if name:
+                task.name = name
+        else:
+            task = dag
+    else:
+        task = _load_task(entrypoint, env, {})
+        if name:
+            task.name = name
     jobs_core.launch(task, name=name, controller=controller)
 
 

@@ -76,3 +76,29 @@ def to_dag(task_or_dag) -> Dag:
     dag = Dag(name=getattr(task_or_dag, 'name', None))
     dag.add(task_or_dag)
     return dag
+
+
+def from_yaml(path: str, env_overrides=None) -> Dag:
+    """Chain Dag from a (possibly multi-document) task YAML — the
+    train->eval pipeline entrypoint (reference:
+    dag_utils.load_chain_dag_from_yaml). Each `---`-separated document
+    is one task; tasks execute sequentially under managed jobs, each on
+    its own cluster (jobs/controller.py per-task loop)."""
+    import os
+
+    import yaml
+
+    from skypilot_tpu import exceptions
+
+    with open(os.path.expanduser(path)) as f:
+        configs = [c for c in yaml.safe_load_all(f) if c is not None]
+    if not configs:
+        raise exceptions.InvalidTaskError(f'{path} contains no tasks')
+    for c in configs:
+        if not isinstance(c, dict):
+            raise exceptions.InvalidTaskError(
+                f'{path}: every YAML document must be a task mapping')
+    dag = Dag(name=configs[0].get('name'))
+    for c in configs:
+        dag.add(Task.from_yaml_config(c, env_overrides))
+    return dag

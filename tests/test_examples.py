@@ -15,10 +15,14 @@ EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(sky.__file__)),
 
 @pytest.mark.parametrize('path', sorted(glob.glob(f'{EXAMPLES}/*.yaml')))
 def test_example_yaml_parses(path):
-    task = sky.Task.from_yaml(path)
-    assert task.run
-    assert task.resources.tpu is not None
+    from skypilot_tpu import dag as dag_lib
+    dag = dag_lib.from_yaml(path)   # handles multi-doc pipelines too
+    assert dag.tasks
+    for task in dag.tasks:
+        assert task.run
+        assert task.resources.tpu is not None
     if 'serve' in os.path.basename(path):
+        [task] = dag.tasks
         assert task.service is not None
         assert task.service.min_replicas >= 1
 
