@@ -79,6 +79,16 @@ class _BadRequest(ValueError):
     pass
 
 
+def _first_stop_match(text: str, stop: Optional[List[str]]) -> int:
+    """Offset of the earliest stop-string match in `text`, or -1. The
+    single matcher both the plain and streaming paths use — they must
+    agree on where a completion ends."""
+    if not stop:
+        return -1
+    hits = [i for i in (text.find(s) for s in stop) if i >= 0]
+    return min(hits) if hits else -1
+
+
 class ModelServer:
 
     @classmethod
@@ -118,10 +128,15 @@ class ModelServer:
                 hf_model)
             # The checkpoint's real EOS, not the byte-tokenizer's (a
             # Llama-3 vocab uses id 2 as an ordinary BPE token).
-            if hf_eos is not None:
-                eos_id = hf_eos
             self.tokenizer = tokenizer_lib.load_tokenizer(hf_model)
             self.model_name = hf_model
+            if hf_eos is not None:
+                eos_id = hf_eos
+            elif (self.tokenizer is not None
+                  and self.tokenizer.eos_id is not None):
+                # config.json without eos_token_id: the tokenizer
+                # assets still know the real EOS.
+                eos_id = self.tokenizer.eos_id
             if self.tokenizer is None:
                 logger.warning(
                     'checkpoint %s ships no tokenizer asset: text '
@@ -286,6 +301,9 @@ class ModelServer:
                 tokens = server._encode_prompt(req.get('prompt'))
                 max_new = int(req.get('max_new_tokens', 64))
                 sampling = server._sampling_from(req)
+                # Pre-validate so a stream request gets a real 400, not
+                # an in-band error frame inside a 200 stream.
+                server.engine._validate(tokens)
                 out_q = self._enqueue(tokens, max_new, sampling)
                 if bool(req.get('stream', False)):
                     # Final 'text'-only frame carries any tail the
@@ -336,9 +354,15 @@ class ModelServer:
                     stop = [stop]
                 if stop is not None and not (
                         isinstance(stop, list)
-                        and all(isinstance(s, str) for s in stop)):
-                    raise _BadRequest('stop must be a string or list '
-                                      'of strings')
+                        and all(isinstance(s, str) and s
+                                for s in stop)):
+                    raise _BadRequest('stop must be a non-empty string '
+                                      'or a list of non-empty strings')
+                # Reject un-servable prompts BEFORE the stream opens:
+                # once SSE headers are out, an engine-side rejection
+                # can only surface as an in-band error frame, which a
+                # client sees as a 200.
+                server.engine._validate(tokens)
                 rid = (f'chatcmpl-{int(time.time()*1000)}' if chat
                        else f'cmpl-{int(time.time()*1000)}')
                 created = int(time.time())
@@ -353,12 +377,10 @@ class ModelServer:
                     return
                 text = server._decode_text(toks)
                 finish = 'length' if len(toks) >= max_new else 'stop'
-                if stop:
-                    cut = min((text.find(s) for s in stop
-                               if text.find(s) >= 0), default=-1)
-                    if cut >= 0:
-                        text = text[:cut]
-                        finish = 'stop'
+                cut = _first_stop_match(text, stop)
+                if cut >= 0:
+                    text = text[:cut]
+                    finish = 'stop'
                 if chat:
                     choice = {'index': 0,
                               'message': {'role': 'assistant',
@@ -464,11 +486,6 @@ class ModelServer:
                 pending = ''
                 n_tokens = 0
                 stopped = False
-
-                def stop_cut(text: str) -> int:
-                    return min((text.find(s) for s in stop
-                                if text.find(s) >= 0), default=-1)
-
                 try:
                     if chat:
                         # Role announcement chunk (OpenAI convention).
@@ -493,7 +510,7 @@ class ModelServer:
                         delta = dec.push(item) if dec else ''
                         if stop:
                             pending += delta
-                            cut = stop_cut(pending)
+                            cut = _first_stop_match(pending, stop)
                             if cut >= 0:
                                 if cut > 0:
                                     self._chunk(frame(pending[:cut],
@@ -512,7 +529,7 @@ class ModelServer:
                     if not stopped:
                         tail = dec.flush() if dec else ''
                         pending += tail
-                        cut = stop_cut(pending) if stop else -1
+                        cut = _first_stop_match(pending, stop)
                         if cut >= 0:
                             pending = pending[:cut]
                             stopped = True
