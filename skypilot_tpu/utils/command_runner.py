@@ -15,6 +15,7 @@ import os
 import shlex
 import subprocess
 import tempfile
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu import exceptions
@@ -254,9 +255,12 @@ class KubectlCommandRunner(CommandRunner):
         exec_args = self._base() + ['exec', self.pod]
         if self.container:
             exec_args += ['-c', self.container]
-        remote = f'bash -c {shlex.quote(_env_prefix(env) + cmd)}'
+        # The command after `--` must be an ARGV VECTOR: kubectl execs
+        # it verbatim in the container (a single 'bash -c ...' string
+        # would be looked up as one binary name and ENOENT).
         return self._finish(
-            exec_args + ['--'], env_cmd='', cmd=remote,
+            exec_args + ['--', 'bash', '-c'],
+            env_cmd=_env_prefix(env), cmd=cmd,
             stream_logs=stream_logs, log_path=log_path,
             require_outputs=require_outputs, check=check, timeout=timeout)
 
@@ -283,6 +287,74 @@ class KubectlCommandRunner(CommandRunner):
         return proc.returncode
 
 
+class DockerCommandRunner(CommandRunner):
+    """Run inside a long-lived container on a host (the `image_id:
+    docker:<image>` runtime — provision/docker_utils.py; reference:
+    sky/provision/docker_utils.py DockerInitializer). Wraps the HOST's
+    runner: commands become `docker exec`, file sync stages through the
+    host filesystem + `docker cp`. Container $HOME is /root, matching
+    the '~' convention of every agent path."""
+
+    def __init__(self, inner_spec: Dict, container: str) -> None:
+        self.inner = runner_from_spec(inner_spec)
+        self.container = container
+
+    @staticmethod
+    def _expand(path: str) -> str:
+        return '/root' + path[1:] if path.startswith('~') else path
+
+    def run(self, cmd: str, *, env: Optional[Dict[str, str]] = None,
+            stream_logs: bool = False, log_path: Optional[str] = None,
+            require_outputs: bool = False, check: bool = False,
+            timeout: Optional[float] = None):
+        full = _env_prefix(env) + cmd
+        wrapped = (f'docker exec {self.container} '
+                   f'bash -c {shlex.quote(full)}')
+        return self.inner.run(wrapped, stream_logs=stream_logs,
+                              log_path=log_path,
+                              require_outputs=require_outputs,
+                              check=check, timeout=timeout)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              check: bool = True) -> int:
+        """Stage on the host, then `docker cp` across the container
+        boundary ('SRC/.' copies directory CONTENTS — the rsync
+        trailing-slash contract the callers rely on). The stage path is
+        per-call unique: multi-host setup fans out one THREAD per host
+        (same pid), and fake-cloud hosts share the real /tmp."""
+        stage = f'/tmp/.skyt-docker-stage-{uuid.uuid4().hex[:12]}'
+        c = self.container
+        try:
+            if up:
+                rc = self.inner.run(f'rm -rf {stage}', check=check)
+                rc = rc or self.inner.rsync(source, stage, up=True,
+                                            check=check)
+                dst = self._expand(target).rstrip('/')
+                merge = source.endswith('/')
+                src = f'{stage}/.' if merge else stage
+                rc = rc or self.inner.run(
+                    f'docker exec {c} mkdir -p '
+                    f'{dst if merge else os.path.dirname(dst) or "/"} '
+                    f'&& docker cp {src} {c}:{dst} && rm -rf {stage}',
+                    check=check)
+                return rc
+            src = self._expand(source).rstrip('/')
+            merge = source.endswith('/')
+            rc = self.inner.run(
+                f'rm -rf {stage} && mkdir -p {stage} && docker cp '
+                f'{c}:{src}{"/." if merge else ""} '
+                f'{stage}{"/" if merge else "/" + os.path.basename(src)}',
+                check=check)
+            rc = rc or self.inner.rsync(
+                stage + ('/' if merge else '/' + os.path.basename(src)),
+                target, up=False, check=check)
+            self.inner.run(f'rm -rf {stage}', check=False)
+            return rc
+        except exceptions.CommandError:
+            self.inner.run(f'rm -rf {stage}', check=False)
+            raise
+
+
 def runner_from_spec(spec: Dict) -> CommandRunner:
     """Rebuild a runner from its serialized form (stored in
     cluster_info.json on the head so the on-head executor can reach
@@ -299,4 +371,6 @@ def runner_from_spec(spec: Dict) -> CommandRunner:
         return KubectlCommandRunner(spec['namespace'], spec['pod'],
                                     container=spec.get('container'),
                                     context=spec.get('context'))
+    if kind == 'docker':
+        return DockerCommandRunner(spec['inner'], spec['container'])
     raise ValueError(f'Unknown runner kind {kind!r}')

@@ -1,0 +1,299 @@
+"""Fake Kubernetes substrate for GKE end-to-end tests.
+
+What the fake cloud (provision/fake/instance.py) is to the GCP TPU-VM
+path, this is to the GKE pod-slice path: a REAL localhost HTTP server
+speaking the pods/services REST surface the provider uses
+(provision/gke/instance.py via k8s_client), plus a fake `kubectl`
+binary on PATH that maps `exec`/`cp` onto local processes and
+directories — so the FULL client stack (optimizer -> provisioner ->
+kubectl runtime sync -> agent daemon -> gang executor -> logs -> down)
+runs with zero mocking inside the product code.
+
+Each pod is a directory (under SKYT_HOME so the test harness's leaked-
+process reaper finds pidfiles); `kubectl exec pod -- argv...` runs argv
+locally with HOME=<pod dir>, mirroring real kubectl's verbatim-argv
+exec semantics (argv[0] containing a space fails with ENOENT exactly
+like a container runtime would).
+"""
+from __future__ import annotations
+
+import glob
+import http.server
+import json
+import os
+import re
+import signal
+import stat
+import threading
+from typing import Dict, Optional
+from urllib.parse import unquote, urlparse
+
+
+class FakeK8s:
+    """Localhost API server + pod sandboxes + fake kubectl."""
+
+    def __init__(self, base_dir: str, bin_dir: str):
+        self.base_dir = base_dir
+        self.state_path = os.path.join(base_dir, 'k8s_state.json')
+        os.makedirs(base_dir, exist_ok=True)
+        self.pods: Dict[str, dict] = {}
+        self.services: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._sync()
+        self._write_kubectl(bin_dir)
+        self._httpd = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), self._make_handler())
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def api_server(self) -> str:
+        return f'http://127.0.0.1:{self._httpd.server_address[1]}'
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- state ---------------------------------------------------------- #
+
+    def pod_dir(self, name: str) -> str:
+        return os.path.join(self.base_dir, name)
+
+    def _sync(self) -> None:
+        """Publish pod -> dir for the fake kubectl (read per invocation)."""
+        mapping = {n: self.pod_dir(n) for n in self.pods}
+        tmp = self.state_path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump(mapping, f)
+        os.replace(tmp, self.state_path)
+
+    def _reap_pod(self, name: str) -> None:
+        """Pod deletion kills every process group whose pidfile lives in
+        the pod dir — a deleted pod's containers don't outlive it."""
+        for pidfile in glob.glob(os.path.join(self.pod_dir(name), '**',
+                                              '*.pid'), recursive=True):
+            try:
+                pid = int(open(pidfile).read().strip())
+            except (OSError, ValueError):
+                continue
+            for kill in (os.killpg, os.kill):
+                try:
+                    kill(pid, signal.SIGKILL)
+                    break
+                except (ProcessLookupError, PermissionError, OSError):
+                    continue
+
+    # -- fake kubectl ---------------------------------------------------- #
+
+    _KUBECTL = r'''#!/usr/bin/env python3
+import json, os, shutil, subprocess, sys
+
+STATE = os.environ['SKYT_FAKE_K8S_STATE']
+
+
+def pod_dir(pod):
+    with open(STATE) as f:
+        mapping = json.load(f)
+    if pod not in mapping:
+        sys.stderr.write(f'Error from server (NotFound): pods "{pod}" '
+                         'not found\n')
+        sys.exit(1)
+    return mapping[pod]
+
+
+def expand(pod_path, d):
+    # The runner maps '~' to '/root'; the pod sandbox HOME is `d`.
+    if pod_path.startswith('/root'):
+        return d + pod_path[len('/root'):]
+    if pod_path.startswith('/'):
+        return d + pod_path
+    return os.path.join(d, pod_path)
+
+
+args = sys.argv[1:]
+# Strip global flags (-n NS, --context CTX).
+flat = []
+skip = False
+for i, a in enumerate(args):
+    if skip:
+        skip = False
+        continue
+    if a in ('-n', '--namespace', '--context'):
+        skip = True
+        continue
+    flat.append(a)
+
+verb = flat[0]
+if verb == 'exec':
+    rest = flat[1:]
+    if '--' not in rest:
+        sys.stderr.write('error: no command specified\n')
+        sys.exit(1)
+    sep = rest.index('--')
+    head, argv = rest[:sep], rest[sep + 1:]
+    pods = [a for a in head if a not in ('-c', '-i', '-t', '-it')
+            and (head[head.index(a) - 1] != '-c'
+                 if head.index(a) > 0 else True)]
+    pod = pods[0]
+    d = pod_dir(pod)
+    if len(argv) == 1 and ' ' in argv[0]:
+        # Real kubectl execs argv verbatim; a space-containing argv[0]
+        # is one (nonexistent) binary name.
+        sys.stderr.write(f'error: exec: "{argv[0]}": executable file '
+                         'not found in $PATH\n')
+        sys.exit(126)
+    env = dict(os.environ, HOME=d)
+    proc = subprocess.run(argv, env=env, cwd=d)
+    sys.exit(proc.returncode)
+
+if verb == 'cp':
+    rest = [a for i, a in enumerate(flat[1:])
+            if a != '-c' and (i == 0 or flat[1:][i - 1] != '-c')]
+    src, dst = rest[0], rest[1]
+
+    def resolve(p):
+        if ':' in p and '/' in p.split(':', 1)[0]:
+            ref, path = p.split(':', 1)
+            return expand(path, pod_dir(ref.split('/', 1)[1]))
+        return p
+
+    src_r, dst_r = resolve(src), resolve(dst)
+    if os.path.isdir(src_r):
+        # kubectl cp DIR target: target becomes a copy of DIR.
+        shutil.copytree(
+            src_r, dst_r.rstrip('/'), dirs_exist_ok=True, symlinks=True,
+            ignore=lambda d, names: {n for n in names
+                                     if n in ('.git', '__pycache__')})
+    else:
+        target = dst_r
+        if target.endswith('/') or os.path.isdir(target):
+            os.makedirs(target, exist_ok=True)
+            target = os.path.join(target, os.path.basename(src_r))
+        else:
+            os.makedirs(os.path.dirname(target) or '.', exist_ok=True)
+        shutil.copy2(src_r, target)
+    sys.exit(0)
+
+sys.stderr.write(f'fake kubectl: unsupported verb {verb!r}\n')
+sys.exit(2)
+'''
+
+    def _write_kubectl(self, bin_dir: str) -> None:
+        os.makedirs(bin_dir, exist_ok=True)
+        path = os.path.join(bin_dir, 'kubectl')
+        with open(path, 'w') as f:
+            f.write(self._KUBECTL)
+        os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR
+                 | stat.S_IXGRP | stat.S_IXOTH)
+
+    # -- REST surface ---------------------------------------------------- #
+
+    def _make_handler(self):
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _err(self, status, reason, message):
+                self._reply(status,
+                            {'reason': reason, 'message': message})
+
+            def _route(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                m = re.match(
+                    r'/api/v1/namespaces/(?P<ns>[^/]+)/'
+                    r'(?P<kind>pods|services)(/(?P<name>[^/?]+))?$',
+                    parsed.path)
+                if not m:
+                    self._err(404, 'NotFound', self.path)
+                    return
+                selector: Optional[str] = None
+                sel = re.search(r'labelSelector=([^&]+)', parsed.query)
+                if sel:
+                    kv = unquote(sel.group(1))
+                    selector = kv.split('=', 1)[1]
+                length = int(self.headers.get('Content-Length', 0))
+                data = (json.loads(self.rfile.read(length))
+                        if length else {})
+                with fake._lock:
+                    self._handle(method, m['kind'], m['name'],
+                                 selector, data)
+
+            def _handle(self, method, kind, name, selector, data):
+                store = (fake.pods if kind == 'pods'
+                         else fake.services)
+                if method == 'POST':
+                    pod_name = data['metadata']['name']
+                    if pod_name in store:
+                        self._err(409, 'AlreadyExists', pod_name)
+                        return
+                    if kind == 'pods':
+                        os.makedirs(fake.pod_dir(pod_name),
+                                    exist_ok=True)
+                        data['status'] = {'phase': 'Running',
+                                          'podIP': '127.0.0.1'}
+                    elif data.get('spec', {}).get('clusterIP') != 'None':
+                        data.setdefault('spec', {})['clusterIP'] = \
+                            f'10.0.0.{len(store) + 2}'
+                    store[pod_name] = data
+                    fake._sync()
+                    self._reply(200, data)
+                    return
+                if method == 'GET' and name is None:
+                    items = list(store.values())
+                    if selector is not None:
+                        items = [
+                            i for i in items
+                            if i['metadata'].get('labels', {}).get(
+                                'skyt-cluster') == selector]
+                    self._reply(200, {'items': items})
+                    return
+                if method == 'GET':
+                    if name not in store:
+                        self._err(404, 'NotFound', name)
+                        return
+                    self._reply(200, store[name])
+                    return
+                if method == 'PUT':
+                    if name not in store:
+                        self._err(404, 'NotFound', name)
+                        return
+                    store[name] = data
+                    self._reply(200, data)
+                    return
+                if method == 'DELETE':
+                    if name not in store:
+                        self._err(404, 'NotFound', name)
+                        return
+                    if kind == 'pods':
+                        fake._reap_pod(name)
+                    del store[name]
+                    fake._sync()
+                    self._reply(200, {'status': 'Success'})
+                    return
+                self._err(405, 'MethodNotAllowed', method)
+
+            def do_GET(self):
+                self._route('GET')
+
+            def do_POST(self):
+                self._route('POST')
+
+            def do_PUT(self):
+                self._route('PUT')
+
+            def do_DELETE(self):
+                self._route('DELETE')
+
+        return Handler
