@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_user_state
 from skypilot_tpu import provision
+from skypilot_tpu.provision import docker_utils
 from skypilot_tpu import resources as resources_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
@@ -137,6 +138,16 @@ class CloudTpuBackend:
             cluster_name, handle, global_user_state.ClusterStatus.INIT,
             is_launch=True)
         provisioner.wait_for_connectivity(result.cluster_info)
+        if docker_utils.is_docker_image(res.image_id):
+            # Container runtime (`image_id: docker:<image>`): start the
+            # long-lived container on every host and rewrite the
+            # runner specs so runtime sync, the daemon, and every job
+            # run INSIDE it; re-persist the handle so later verbs
+            # (exec/logs/down) reconstruct docker runners.
+            docker_utils.initialize_docker_on_cluster(
+                result.cluster_info, docker_utils.image_name(res.image_id))
+            global_user_state.add_or_update_cluster(
+                cluster_name, handle, global_user_state.ClusterStatus.INIT)
         provisioner.setup_runtime_on_cluster(result.cluster_info)
         provisioner.start_agent_daemon(result.cluster_info)
         global_user_state.set_cluster_status(

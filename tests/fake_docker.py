@@ -1,0 +1,151 @@
+"""Fake `docker` binary for container-runtime e2e tests.
+
+State is scoped per HOST via $HOME (the fake cloud's LocalCommandRunner
+sets HOME=<host dir>), mirroring how each real VM has its own docker
+daemon: images + containers live under $HOME/.fake_docker, a container
+is a directory, `docker exec` runs argv with HOME=<container dir>, and
+`docker cp` maps `/root` to the container dir (container $HOME contract
+of utils/command_runner.DockerCommandRunner).
+"""
+import os
+import stat
+
+FAKE_DOCKER = r'''#!/usr/bin/env python3
+import glob, json, os, shutil, signal, subprocess, sys
+
+HOME = os.environ['HOME']
+BASE = os.path.join(HOME, '.fake_docker')
+STATE = os.path.join(BASE, 'state.json')
+
+
+def load():
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {'images': [], 'containers': {}}
+
+
+def save(st):
+    os.makedirs(BASE, exist_ok=True)
+    with open(STATE, 'w') as f:
+        json.dump(st, f)
+
+
+def cdir(st, name):
+    if name not in st['containers']:
+        sys.stderr.write(f'Error: No such container: {name}\n')
+        sys.exit(1)
+    return st['containers'][name]
+
+
+def expand(path, d):
+    if path.startswith('/root'):
+        return d + path[len('/root'):]
+    return d + path if path.startswith('/') else os.path.join(d, path)
+
+
+args = sys.argv[1:]
+verb = args[0] if args else ''
+
+if verb == '--version':
+    print('Docker version 24.0.0 (fake)')
+    sys.exit(0)
+
+if verb == 'image' and args[1:2] == ['inspect']:
+    st = load()
+    sys.exit(0 if args[2] in st['images'] else 1)
+
+if verb == 'pull':
+    st = load()
+    if args[1] not in st['images']:
+        st['images'].append(args[1])
+    save(st)
+    print(f'fake: pulled {args[1]}')
+    sys.exit(0)
+
+if verb == 'rm':
+    name = args[-1]
+    st = load()
+    d = st['containers'].pop(name, None)
+    save(st)
+    if d is None:
+        sys.exit(0 if '-f' in args else 1)
+    for pidfile in glob.glob(os.path.join(d, '**', '*.pid'),
+                             recursive=True):
+        try:
+            pid = int(open(pidfile).read().strip())
+        except (OSError, ValueError):
+            continue
+        for kill in (os.killpg, os.kill):
+            try:
+                kill(pid, signal.SIGKILL)
+                break
+            except (ProcessLookupError, PermissionError, OSError):
+                continue
+    sys.exit(0)
+
+if verb == 'run':
+    name = args[args.index('--name') + 1]
+    st = load()
+    d = os.path.join(BASE, 'containers', name)
+    os.makedirs(d, exist_ok=True)
+    st['containers'][name] = d
+    save(st)
+    print('f' * 64)   # container id
+    sys.exit(0)
+
+if verb == 'exec':
+    name = args[1]
+    argv = args[2:]
+    st = load()
+    d = cdir(st, name)
+    if len(argv) == 1 and ' ' in argv[0]:
+        sys.stderr.write(f'exec: "{argv[0]}": executable file not '
+                         'found in $PATH\n')
+        sys.exit(126)
+    env = dict(os.environ, HOME=d)
+    sys.exit(subprocess.run(argv, env=env, cwd=d).returncode)
+
+if verb == 'cp':
+    src, dst = args[1], args[2]
+    st = load()
+
+    def resolve(p):
+        if ':' in p and not p.startswith('/'):
+            name, path = p.split(':', 1)
+            return expand(path, cdir(st, name))
+        return p
+
+    merge = src.endswith('/.')
+    src_r = resolve(src[:-2] if merge else src)
+    dst_r = resolve(dst)
+    if merge or os.path.isdir(src_r):
+        target = dst_r if merge else (
+            os.path.join(dst_r, os.path.basename(src_r))
+            if os.path.isdir(dst_r) else dst_r)
+        os.makedirs(target, exist_ok=True)
+        shutil.copytree(src_r, target, dirs_exist_ok=True,
+                        symlinks=True)
+    else:
+        if dst_r.endswith('/') or os.path.isdir(dst_r):
+            os.makedirs(dst_r, exist_ok=True)
+            dst_r = os.path.join(dst_r, os.path.basename(src_r))
+        else:
+            os.makedirs(os.path.dirname(dst_r) or '.', exist_ok=True)
+        shutil.copy2(src_r, dst_r)
+    sys.exit(0)
+
+sys.stderr.write(f'fake docker: unsupported: {args}\n')
+sys.exit(2)
+'''
+
+
+def write_fake_docker(bin_dir: str) -> str:
+    os.makedirs(bin_dir, exist_ok=True)
+    path = os.path.join(bin_dir, 'docker')
+    with open(path, 'w') as f:
+        f.write(FAKE_DOCKER)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP
+             | stat.S_IXOTH)
+    return path
