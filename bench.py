@@ -38,7 +38,8 @@ def _tpu_chip_flops(device) -> float:
 
 def _measure_mfu(cfg, batch: int, seq: int, steps: int, peak: float):
     """Compile + time `steps` train steps of `cfg` on one chip; returns
-    (mfu_pct, tok_per_s)."""
+    (mfu_pct, tok_per_s, first_step_s) — first_step_s is compile +
+    first execution, the launch report's last leg."""
     import jax
     from skypilot_tpu.parallel import mesh as mesh_lib
     from skypilot_tpu.train import trainer
@@ -54,8 +55,10 @@ def _measure_mfu(cfg, batch: int, seq: int, steps: int, peak: float):
     # Warmup / compile. Sync with a host transfer (float()), not
     # block_until_ready: through remote-execution relays (axon tunnel) the
     # latter can return before the computation actually retires.
+    t_first = time.perf_counter()
     state, metrics = step(state, batch_dict)
     float(metrics['loss'])
+    first_step_s = time.perf_counter() - t_first
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -66,7 +69,7 @@ def _measure_mfu(cfg, batch: int, seq: int, steps: int, peak: float):
 
     tok_per_s = batch * seq * steps / dt
     mfu_pct = 100.0 * tok_per_s * cfg.flops_per_token(seq) / peak
-    return mfu_pct, tok_per_s
+    return mfu_pct, tok_per_s, first_step_s
 
 
 def _flagship_projection(device, peak: float):
@@ -86,7 +89,7 @@ def _flagship_projection(device, peak: float):
 
     cfg = dataclasses.replace(llama.llama3_8b(), n_layers=2,
                               vocab_size=32768)
-    mfu_pct, tok_per_s = _measure_mfu(
+    mfu_pct, tok_per_s, _ = _measure_mfu(
         cfg, batch=1, seq=flagship.FLAGSHIP_SEQ, steps=5, peak=peak)
     return {
         'config': 'llama3-8b',
@@ -146,9 +149,87 @@ def _serving_throughput(device):
         return {'error': str(e)[:200]}
 
 
+def _launch_to_first_step(first_step_s=None):
+    """BASELINE north-star 1: launch -> first train step, one tracked
+    number per round. Decomposition: a REAL `sky.launch` on the fake
+    (localhost) cloud — optimizer, failover provisioner, kubectl-free
+    runtime sync, agent submit, job to SUCCEEDED — timed per stage from
+    the timeline trace, plus the first-train-step compile+execute time
+    measured on this chip by _measure_mfu. Real-cloud launches add TPU
+    VM creation (cloud-side, reference-identical); everything the
+    FRAMEWORK contributes is in these numbers. Best-effort."""
+    import json as json_lib
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    import skypilot_tpu as sky
+
+    repo = os.path.dirname(os.path.abspath(sky.__file__))
+    code = (
+        "import time, json, sys\n"
+        "import skypilot_tpu as sky\n"
+        "from skypilot_tpu import core\n"
+        "t = sky.Task(name='bench-launch', run='true')\n"
+        "t.set_resources(sky.Resources.new(accelerators='tpu-v5e-8',"
+        " cloud='fake'))\n"
+        "t0 = time.perf_counter()\n"
+        "job_id, _ = sky.launch(t, cluster_name='bench-launch',"
+        " quiet_optimizer=True, detach_run=True)\n"
+        "while core.job_status('bench-launch', job_id) not in"
+        " ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):\n"
+        "    time.sleep(0.1)\n"
+        "dt = time.perf_counter() - t0\n"
+        "core.down('bench-launch')\n"
+        "print(json.dumps({'launch_to_job_done_s': dt}))\n")
+    with tempfile.TemporaryDirectory() as td:
+        trace = os.path.join(td, 'trace.json')
+        proc = subprocess.run(
+            [sys.executable, '-c', code], capture_output=True,
+            text=True, timeout=300,
+            env={**os.environ,
+                 'SKYT_HOME': os.path.join(td, 'home'),
+                 'SKYT_ENABLE_FAKE_CLOUD': '1',
+                 'SKYT_TIMELINE_FILE': trace,
+                 'JAX_PLATFORMS': 'cpu',
+                 'PYTHONPATH': os.path.dirname(repo) + os.pathsep
+                 + os.environ.get('PYTHONPATH', '')})
+        if proc.returncode != 0:
+            return {'error': proc.stderr[-300:]}
+        total = json_lib.loads(
+            proc.stdout.strip().splitlines()[-1])['launch_to_job_done_s']
+        stages = {}
+        with open(trace) as f:
+            for e in json_lib.load(f).get('traceEvents', []):
+                key = e['name'].split('(')[0]
+                stages[key] = round(
+                    stages.get(key, 0.0) + e.get('dur', 0) / 1e6, 3)
+        wanted = {k: v for k, v in stages.items()
+                  if any(s in k for s in (
+                      'provision', 'setup_runtime', 'start_agent',
+                      'execute', 'submit'))}
+    report = {'fake_cloud_launch_to_job_done_s': round(total, 2),
+              'stages_s': wanted}
+    if first_step_s is not None:
+        report['first_train_step_compile_and_run_s'] = round(
+            first_step_s, 2)
+        report['launch_plus_first_step_s'] = round(
+            total + first_step_s, 2)
+    return report
+
+
 def main() -> None:
+    import os
+
     import jax
     from skypilot_tpu.models import llama
+
+    # Honor JAX_PLATFORMS=cpu even under the axon TPU tunnel, whose
+    # plugin self-registers regardless of the env var (same pin as
+    # tests/conftest.py) — a CPU bench run must not touch the tunnel.
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        jax.config.update('jax_platforms', 'cpu')
 
     device = jax.devices()[0]
     on_tpu = device.platform != 'cpu'
@@ -165,13 +246,18 @@ def main() -> None:
         batch, seq, steps = 4, 128, 3
 
     peak = _tpu_chip_flops(device) if on_tpu else 1e12
-    mfu_pct, tok_per_s = _measure_mfu(cfg, batch, seq, steps, peak)
+    mfu_pct, tok_per_s, first_step_s = _measure_mfu(cfg, batch, seq,
+                                                    steps, peak)
 
     flagship_report = None
     serving_report = None
     if on_tpu:
         flagship_report = _flagship_projection(device, peak)
         serving_report = _serving_throughput(device)
+    try:
+        launch_report = _launch_to_first_step(first_step_s)
+    except Exception as e:  # noqa: BLE001 — optional metric
+        launch_report = {'error': str(e)[:200]}
 
     print(json.dumps({
         'metric': 'llama_train_mfu_single_chip',
@@ -182,6 +268,7 @@ def main() -> None:
         'vs_baseline': round(mfu_pct / REF_MFU_PCT, 2),
         'flagship': flagship_report,
         'serving': serving_report,
+        'launch': launch_report,
     }))
 
 
