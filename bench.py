@@ -177,12 +177,15 @@ def _launch_to_first_step(first_step_s=None):
         "t0 = time.perf_counter()\n"
         "job_id, _ = sky.launch(t, cluster_name='bench-launch',"
         " quiet_optimizer=True, detach_run=True)\n"
-        "while core.job_status('bench-launch', job_id) not in"
-        " ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):\n"
+        "while True:\n"
+        "    status = core.job_status('bench-launch', job_id)\n"
+        "    if status in ('SUCCEEDED', 'FAILED', 'FAILED_SETUP'):\n"
+        "        break\n"
         "    time.sleep(0.1)\n"
         "dt = time.perf_counter() - t0\n"
         "core.down('bench-launch')\n"
-        "print(json.dumps({'launch_to_job_done_s': dt}))\n")
+        "print(json.dumps({'launch_to_job_done_s': dt,"
+        " 'status': status}))\n")
     with tempfile.TemporaryDirectory() as td:
         trace = os.path.join(td, 'trace.json')
         proc = subprocess.run(
@@ -197,18 +200,36 @@ def _launch_to_first_step(first_step_s=None):
                  + os.environ.get('PYTHONPATH', '')})
         if proc.returncode != 0:
             return {'error': proc.stderr[-300:]}
-        total = json_lib.loads(
-            proc.stdout.strip().splitlines()[-1])['launch_to_job_done_s']
-        stages = {}
+        result = json_lib.loads(proc.stdout.strip().splitlines()[-1])
+        if result.get('status') != 'SUCCEEDED':
+            # A failed launch must not masquerade as a tracked number.
+            return {'error': f'bench launch job ended '
+                             f'{result.get("status")}'}
+        total = result['launch_to_job_done_s']
+        # DISJOINT leaf stages only (each span below covers distinct
+        # wall-clock; umbrella spans like execution._execute or
+        # backend.provision nest the leaves and would double-count).
+        leaf_names = {
+            'provision.bootstrap': 'provision_bootstrap',
+            'provision.run_instances': 'provision_create',
+            'provision.wait_instances': 'provision_boot_wait',
+            'skypilot_tpu.provision.provisioner.wait_for_connectivity':
+                'wait_connectivity',
+            'skypilot_tpu.provision.provisioner.setup_runtime_on_cluster':
+                'runtime_sync',
+            'skypilot_tpu.provision.provisioner.start_agent_daemon':
+                'start_daemon',
+            'skypilot_tpu.backend.cloud_tpu_backend.CloudTpuBackend'
+            '.execute': 'job_submit_and_run',
+        }
+        wanted = {}
         with open(trace) as f:
             for e in json_lib.load(f).get('traceEvents', []):
-                key = e['name'].split('(')[0]
-                stages[key] = round(
-                    stages.get(key, 0.0) + e.get('dur', 0) / 1e6, 3)
-        wanted = {k: v for k, v in stages.items()
-                  if any(s in k for s in (
-                      'provision', 'setup_runtime', 'start_agent',
-                      'execute', 'submit'))}
+                short = leaf_names.get(e['name'].split('(')[0])
+                if short is not None:
+                    wanted[short] = round(
+                        wanted.get(short, 0.0)
+                        + e.get('dur', 0) / 1e6, 3)
     report = {'fake_cloud_launch_to_job_done_s': round(total, 2),
               'stages_s': wanted}
     if first_step_s is not None:

@@ -9,12 +9,17 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from typing import Deque, List, Optional
 
 from skypilot_tpu.serve.service_spec import SkyServiceSpec
 
-QPS_WINDOW_SECONDS = 60.0
+# Sliding window for the QPS estimate. Env-overridable so accelerated
+# soak tests (tests/test_stress.py) can compress hours of traffic churn
+# into seconds, same knob pattern as SKYT_SERVE_TICK_SECONDS.
+QPS_WINDOW_SECONDS = float(
+    os.environ.get('SKYT_SERVE_QPS_WINDOW_SECONDS', '60'))
 
 
 @dataclasses.dataclass

@@ -51,6 +51,7 @@ _PASSTHROUGH_ENV_VARS = (
     'SKYT_JOBS_RETRY_GAP_SECONDS',
     'SKYT_JOBS_MAX_RESTARTS_ON_ERRORS',
     'SKYT_SERVE_TICK_SECONDS',
+    'SKYT_SERVE_QPS_WINDOW_SECONDS',
     'SKYT_AGENT_LOOP_SECONDS',
 )
 
