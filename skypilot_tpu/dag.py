@@ -1,14 +1,18 @@
-"""Dag: an ordered container of Tasks (reference: sky/dag.py, 106 LoC).
+"""Dag: Tasks + dependency edges (reference: sky/dag.py, 106 LoC).
 
-The reference stores a networkx digraph but only chains are supported in
-practice (execution.py:180 asserts a single task). We store an explicit list
-of tasks with implicit chain edges — the optimizer's DP handles chains
-directly, and managed jobs execute tasks sequentially.
+The reference stores a networkx digraph; in practice its executor only
+runs chains (execution.py:180 asserts a single task) and managed jobs
+run the task list sequentially. Here the digraph is explicit but
+dependency-light: tasks with no `depends_on` edges form the implicit
+chain (document order), general DAGs declare edges by upstream task
+name, and `topological_order()` gives managed jobs a valid sequential
+schedule for either shape (jobs/controller.py runs it; the optimizer's
+egress-aware placement walks the same edges).
 """
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from skypilot_tpu.task import Task
 
@@ -17,16 +21,92 @@ class Dag:
     def __init__(self, name: Optional[str] = None) -> None:
         self.name = name
         self.tasks: List[Task] = []
+        # (parent, child) Task pairs. Tasks' declarative `depends_on`
+        # (names) are resolved into edges by resolve_edges().
+        self._edges: List[Tuple[Task, Task]] = []
 
     def add(self, task: Task) -> None:
         self.tasks.append(task)
 
     def remove(self, task: Task) -> None:
         self.tasks.remove(task)
+        self._edges = [(p, c) for p, c in self._edges
+                       if p is not task and c is not task]
+
+    def add_edge(self, parent: Task, child: Task) -> None:
+        from skypilot_tpu import exceptions
+        if parent not in self.tasks or child not in self.tasks:
+            raise exceptions.InvalidTaskError(
+                'add_edge: both tasks must be added to the dag first')
+        if (parent, child) not in self._edges:
+            self._edges.append((parent, child))
+
+    def edges(self) -> List[Tuple[Task, Task]]:
+        return list(self._edges)
+
+    def resolve_edges(self) -> None:
+        """Turn every task's declarative `depends_on` names into edges.
+        Unknown names are loud errors (a silent miss would drop an
+        ordering constraint)."""
+        from skypilot_tpu import exceptions
+        by_name = {}
+        for t in self.tasks:
+            if not t.name:
+                continue
+            if t.name in by_name and any(
+                    other.depends_on and t.name in other.depends_on
+                    for other in self.tasks):
+                # A depends_on referencing an ambiguous name would bind
+                # silently to one of them — dropped ordering constraint.
+                raise exceptions.InvalidTaskError(
+                    f'duplicate task name {t.name!r} is referenced by '
+                    'a depends_on; give the tasks distinct names')
+            by_name[t.name] = t
+        for t in self.tasks:
+            for dep in t.depends_on:
+                parent = by_name.get(dep)
+                if parent is None:
+                    raise exceptions.InvalidTaskError(
+                        f'task {t.name!r} depends_on unknown task '
+                        f'{dep!r}')
+                self.add_edge(parent, t)
+
+    def topological_order(self) -> List[Task]:
+        """Kahn's algorithm, stable by insertion order; raises on
+        cycles. With no edges this is exactly the document-order
+        chain."""
+        from skypilot_tpu import exceptions
+        indeg = {id(t): 0 for t in self.tasks}
+        for _p, c in self._edges:
+            indeg[id(c)] += 1
+        order: List[Task] = []
+        ready = [t for t in self.tasks if indeg[id(t)] == 0]
+        while ready:
+            t = ready.pop(0)
+            order.append(t)
+            for p, c in self._edges:
+                if p is t:
+                    indeg[id(c)] -= 1
+                    if indeg[id(c)] == 0:
+                        ready.append(c)
+        if len(order) != len(self.tasks):
+            stuck = [t.name or '?' for t in self.tasks
+                     if t not in order]
+            raise exceptions.InvalidTaskError(
+                f'dependency cycle among tasks: {stuck}')
+        return order
 
     @property
     def is_chain(self) -> bool:
-        return True  # by construction
+        """True when the edges impose no branching (each task has at
+        most one parent and one child) — incl. the edge-free default."""
+        outs = [0] * len(self.tasks)
+        ins = [0] * len(self.tasks)
+        idx = {id(t): i for i, t in enumerate(self.tasks)}
+        for p, c in self._edges:
+            outs[idx[id(p)]] += 1
+            ins[idx[id(c)]] += 1
+        return all(o <= 1 for o in outs) and all(i <= 1 for i in ins)
 
     def __len__(self) -> int:
         return len(self.tasks)
@@ -98,7 +178,17 @@ def from_yaml(path: str, env_overrides=None) -> Dag:
         if not isinstance(c, dict):
             raise exceptions.InvalidTaskError(
                 f'{path}: every YAML document must be a task mapping')
-    dag = Dag(name=configs[0].get('name'))
+    return from_yaml_configs(configs, env_overrides,
+                             name=configs[0].get('name'))
+
+
+def from_yaml_configs(configs, env_overrides=None,
+                      name: Optional[str] = None) -> Dag:
+    """Chain/DAG from already-parsed task config dicts (the managed-jobs
+    controller re-reads its dag YAML through this). `depends_on` names
+    become edges; no edges means the implicit document-order chain."""
+    dag = Dag(name=name)
     for c in configs:
         dag.add(Task.from_yaml_config(c, env_overrides))
+    dag.resolve_edges()
     return dag

@@ -43,10 +43,15 @@ class JobsController:
         self.job_id = job_id
         record = state.get_job(job_id)
         assert record is not None, f'managed job {job_id} not found'
+        from skypilot_tpu import dag as dag_lib
         with open(record['dag_yaml']) as f:
-            configs = list(yaml.safe_load_all(f))
-        self.tasks = [task_lib.Task.from_yaml_config(c) for c in configs
-                      if c is not None]
+            configs = [c for c in yaml.safe_load_all(f)
+                       if c is not None]
+        # Topological order: a valid sequential schedule for chains AND
+        # general DAGs (depends_on edges). Reference runs its per-task
+        # loop the same sequential way (sky/jobs/controller.py:116).
+        self.tasks = dag_lib.from_yaml_configs(
+            configs).topological_order()
         self.backend = CloudTpuBackend()
         self._cancelled = False
 

@@ -86,12 +86,86 @@ def optimize_task(task: task_lib.Task,
                          hourly_cost=per_node * task.num_nodes)
 
 
+# GCP inter-region data transfer (GCS cross-region reads / inter-region
+# egress, $/GB, conservative list rate). The egress MODEL matches the
+# reference's (sky/optimizer.py:77-108 prices parent->child data
+# movement); the rate table is GCP-only by design (SURVEY §7 descope).
+EGRESS_USD_PER_GB = 0.01
+# Without a runtime estimate the egress/hourly trade uses this horizon
+# (the reference uses a 1-hour default time estimate the same way).
+DEFAULT_RUNTIME_HOURS = 1.0
+
+
+def _apply_egress_placement(dag: dag_lib.Dag,
+                            plans: List[OptimizedPlan]) -> None:
+    """Egress-aware placement for DAG edges: when a child task's chosen
+    region differs from its parent's and the parent declares
+    `outputs: {estimated_size_gb: N}`, re-pin the child to the parent's
+    region if hourly-price-delta x runtime < one-off egress cost.
+    Mutates the plans' best_resources/candidates in place. Edges are
+    processed parents-first (topological order), so a parent's own
+    placement is FINAL before any of its children co-locate with it —
+    declaration-order processing could pin a child to a region its
+    parent later leaves."""
+    plan_by_task = {id(p.task): p for p in plans}
+    topo_idx = {id(t): i for i, t in enumerate(dag.topological_order())}
+    for parent, child in sorted(dag.edges(),
+                                key=lambda e: topo_idx[id(e[0])]):
+        out_gb = parent.estimated_output_gb
+        if not out_gb:
+            continue
+        p_plan = plan_by_task[id(parent)]
+        c_plan = plan_by_task[id(child)]
+        p_region = p_plan.task.best_resources.region
+        c_res = c_plan.task.best_resources
+        if (c_res.region == p_region
+                or c_plan.task.resources.region is not None):
+            continue   # already co-located, or user pinned the region
+        same_region = [o for o in c_plan.candidates
+                       if o.region == p_region]
+        if not same_region:
+            continue
+        egress_cost = out_gb * EGRESS_USD_PER_GB
+        use_spot = c_plan.task.resources.use_spot
+        n = c_plan.task.num_nodes
+        delta_hr = (same_region[0].price(use_spot)
+                    - c_plan.chosen.price(use_spot)) * n
+        if delta_hr * DEFAULT_RUNTIME_HOURS < egress_cost:
+            chosen = same_region[0]
+            c_plan.chosen = chosen
+            # Failover still roams: co-located candidates first.
+            c_plan.candidates = same_region + [
+                o for o in c_plan.candidates if o not in same_region]
+            # Rebuild best_resources FROM the new offering (mirror of
+            # optimize_task): region alone is not enough — the cheapest
+            # same-region candidate may be a different shape.
+            if hasattr(chosen, 'topology'):
+                c_plan.task.best_resources = c_res.copy(
+                    tpu=chosen.topology, region=p_region)
+            else:
+                c_plan.task.best_resources = c_res.copy(
+                    instance_type=chosen.instance_type, region=p_region)
+            c_plan.hourly_cost = chosen.price(use_spot) * n
+            logger.info(
+                'egress-aware placement: %r moved to region %s '
+                '(parent %r hands it %.0f GB; egress $%.2f > '
+                'price delta $%.3f/h)', child.name, p_region,
+                parent.name, out_gb, egress_cost, delta_hr)
+
+
 def optimize(dag: dag_lib.Dag,
              minimize: OptimizeTarget = OptimizeTarget.COST,
              quiet: bool = False) -> List[OptimizedPlan]:
-    """Optimize every task in the chain (reference: Optimizer.optimize,
-    sky/optimizer.py:110)."""
-    plans = [optimize_task(t, minimize) for t in dag.tasks]
+    """Optimize every task (chain or general DAG; reference:
+    Optimizer.optimize sky/optimizer.py:110, chain DP :411 / ILP :472).
+    Per-task minimization is exact for independent tasks; dependency
+    edges then get the egress-aware co-location pass — the capability
+    the reference's ILP buys, expressed as a post-pass because our
+    cost model has no other inter-task coupling (data moves via
+    GCS)."""
+    dag.resolve_edges()
+    plans = [optimize_task(t, minimize) for t in dag.topological_order()]
+    _apply_egress_placement(dag, plans)
     if not quiet:
         print(format_plan_table(plans))
     return plans

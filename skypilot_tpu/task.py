@@ -38,6 +38,8 @@ class Task:
         num_nodes: int = 1,
         file_mounts: Optional[Dict[str, str]] = None,
         storage_mounts: Optional[Dict[str, Any]] = None,
+        depends_on: Optional[List[str]] = None,
+        estimated_output_gb: Optional[float] = None,
     ) -> None:
         self.name = name
         self.setup = setup
@@ -57,6 +59,14 @@ class Task:
         # accelerator choices whose HBM cannot hold the training state.
         self.train_footprint: Optional[Any] = None
         self.best_resources = None           # filled by the optimizer
+        # DAG edges by task name (general DAGs, not just chains —
+        # reference: sky/dag.py stores a networkx digraph; managed jobs
+        # execute a topological order, dag.py owns the ordering).
+        self.depends_on: List[str] = list(depends_on or [])
+        # Data handed to downstream tasks (YAML `outputs:
+        # {estimated_size_gb: N}`) — feeds the optimizer's egress-aware
+        # placement (reference: sky/optimizer.py:77-108 egress cost).
+        self.estimated_output_gb: Optional[float] = estimated_output_gb
         self._validate()
 
     # ------------------------------------------------------------------ #
@@ -129,6 +139,13 @@ class Task:
             num_nodes=int(config.get('num_nodes') or 1),
             file_mounts=copy_mounts,
             storage_mounts=storage_mounts,
+            depends_on=[str(d) for d in (config.get('depends_on')
+                                         or [])],
+            estimated_output_gb=(
+                float(config['outputs']['estimated_size_gb'])
+                if isinstance(config.get('outputs'), dict)
+                and config['outputs'].get('estimated_size_gb')
+                is not None else None),
         )
         task.resources = resources_lib.Resources.from_yaml_config(
             config.get('resources'))
@@ -192,6 +209,11 @@ class Task:
             cfg['train_footprint'] = self.train_footprint.to_yaml_config()
         if self.service is not None:
             cfg['service'] = self.service.to_yaml_config()
+        if self.depends_on:
+            cfg['depends_on'] = list(self.depends_on)
+        if self.estimated_output_gb is not None:
+            cfg['outputs'] = {
+                'estimated_size_gb': self.estimated_output_gb}
         return cfg
 
     def to_yaml(self, path: str) -> None:

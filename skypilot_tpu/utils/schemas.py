@@ -24,7 +24,8 @@ TASK_FIELDS: Dict[str, Any] = {
     'service': dict,
     'train_footprint': dict,   # optimizer HBM-feasibility hint
     'inputs': dict,     # accepted for reference-YAML compat, unused
-    'outputs': dict,    # accepted for reference-YAML compat, unused
+    'outputs': dict,    # outputs.estimated_size_gb feeds egress costing
+    'depends_on': list,  # DAG edges by upstream task name
 }
 
 TRAIN_FOOTPRINT_FIELDS: Dict[str, Any] = {
