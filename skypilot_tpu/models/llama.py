@@ -438,14 +438,52 @@ def forward(params: Params, tokens: jax.Array,
 
 KV_CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
 KV_LAYER_SPEC = P(('dp', 'fsdp'), None, 'tp', None)   # per-layer slice
+# Per-token scales of an int8 cache: [L, B, T, KV] (head_dim reduced).
+KV_SCALE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp')
 
 
-def init_kv_cache(cfg: LlamaConfig, batch_size: int,
-                  max_len: int) -> Params:
+def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+                  quantized: bool = False) -> Params:
+    """KV cache; `quantized` stores int8 values + per-(token, kv-head)
+    fp32 scales (quant.QTensor leaves — a pytree, so jit/scan/sharding
+    plumbing is unchanged). Decode streams the whole cache every step,
+    so int8 halves its HBM traffic AND its residency (bigger decode
+    batches in the same chip)."""
     shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads,
              cfg.head_dim)
+    if quantized:
+        def leaf():
+            return quant.QTensor(
+                q=_shard(jnp.zeros(shape, jnp.int8), KV_CACHE_SPEC),
+                scale=_shard(jnp.zeros(shape[:-1], jnp.float32),
+                             KV_SCALE_SPEC))
+        return {'k': leaf(), 'v': leaf()}
     return {'k': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC),
             'v': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC)}
+
+
+def kv_cache_specs(quantized: bool = False) -> Params:
+    """PartitionSpec tree matching init_kv_cache's structure (the
+    engine's out_shardings need the QTensor sub-structure too)."""
+    if quantized:
+        def leaf():
+            return quant.QTensor(q=KV_CACHE_SPEC, scale=KV_SCALE_SPEC)
+        return {'k': leaf(), 'v': leaf()}
+    return {'k': KV_CACHE_SPEC, 'v': KV_CACHE_SPEC}
+
+
+def quantize_kv(x: jax.Array) -> 'quant.QTensor':
+    """Per-(token, head) symmetric int8 over head_dim (x [..., hd])."""
+    return quant.quantize(x, reduce_axes=(-1,))
+
+
+def _dense_kv(x) -> jax.Array:
+    """Dense view of a (possibly int8) cache slice; the int8->bf16
+    convert + scale fuse into the consuming attention matmul the same
+    way weight dequant does in quant.qdot."""
+    if isinstance(x, quant.QTensor):
+        return quant.dequantize(x, reduce_axes=(-1,))
+    return x
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -459,6 +497,8 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     writes the single new token column afterwards, so a step never
     copies the full cache (HBM write traffic per step drops from
     O(cache) to O(B*KV*hd) per layer)."""
+    k_cache = _dense_kv(k_cache)   # int8 cache: dequant fuses into the
+    v_cache = _dense_kv(v_cache)   # einsum reads (weights-style)
     b, _, h, hd = q.shape
     t = k_cache.shape[1]
     kv_heads = k_cache.shape[2]
@@ -502,25 +542,39 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
     x = quant.qtake(params['embed'], tokens, cfg.dtype)[:, None]  # [B,1,D]
     rows = jnp.arange(tokens.shape[0])
 
+    def shard_layer_slice(leaf):
+        if isinstance(leaf, quant.QTensor):
+            return quant.QTensor(
+                q=_shard(leaf.q, KV_LAYER_SPEC),
+                scale=_shard(leaf.scale, P(('dp', 'fsdp'), None, 'tp')))
+        return _shard(leaf, KV_LAYER_SPEC)
+
+    def write_token(cache_leaf, new, li):
+        """Scatter this step's [B,1,KV,hd] token into the full cache —
+        int8 caches quantize per (token, head) at write time."""
+        if isinstance(cache_leaf, quant.QTensor):
+            qt = quantize_kv(new[:, 0])
+            return quant.QTensor(
+                q=cache_leaf.q.at[li, rows, lengths].set(qt.q),
+                scale=cache_leaf.scale.at[li, rows, lengths].set(
+                    qt.scale))
+        return cache_leaf.at[li, rows, lengths].set(
+            new[:, 0].astype(cache_leaf.dtype))
+
     def one_layer(x, k_all, v_all, layer_params, li, k_l, v_l):
-        k_l = _shard(k_l, KV_LAYER_SPEC)
-        v_l = _shard(v_l, KV_LAYER_SPEC)
+        k_l = shard_layer_slice(k_l)
+        v_l = shard_layer_slice(v_l)
         x, (nk, nv) = layer_body(x, layer_params, angles,
                                  (k_l, v_l, lengths))
-        k_all = k_all.at[li, rows, lengths].set(
-            nk[:, 0].astype(k_all.dtype))
-        v_all = v_all.at[li, rows, lengths].set(
-            nv[:, 0].astype(v_all.dtype))
-        return x, k_all, v_all
+        return x, write_token(k_all, nk, li), write_token(v_all, nv, li)
 
     if cfg.scan_layers:
         def body(carry, xs):
             x, k_all, v_all = carry
             layer_params, li = xs
-            k_l = jax.lax.dynamic_index_in_dim(k_all, li, axis=0,
-                                               keepdims=False)
-            v_l = jax.lax.dynamic_index_in_dim(v_all, li, axis=0,
-                                               keepdims=False)
+            k_l, v_l = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, li, axis=0, keepdims=False), (k_all, v_all))
             return one_layer(x, k_all, v_all, layer_params, li,
                              k_l, v_l), None
 
@@ -531,8 +585,9 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
         new_k, new_v = cache['k'], cache['v']
         for i in range(cfg.n_layers):
             layer_params = jax.tree.map(lambda p: p[i], params['layers'])
+            k_l, v_l = jax.tree.map(lambda a: a[i], (new_k, new_v))
             x, new_k, new_v = one_layer(x, new_k, new_v, layer_params,
-                                        i, new_k[i], new_v[i])
+                                        i, k_l, v_l)
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
                            preferred_element_type=jnp.float32)

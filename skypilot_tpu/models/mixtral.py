@@ -276,8 +276,12 @@ def forward(params: Params, tokens: jax.Array, cfg: MixtralConfig,
 # attention path's (llama.init_kv_cache); the MoE FFN has no cache state.
 
 def init_kv_cache(cfg: MixtralConfig, batch_size: int,
-                  max_len: int) -> Params:
-    return llama.init_kv_cache(cfg._attn_cfg(), batch_size, max_len)
+                  max_len: int, quantized: bool = False) -> Params:
+    return llama.init_kv_cache(cfg._attn_cfg(), batch_size, max_len,
+                               quantized=quantized)
+
+
+kv_cache_specs = llama.kv_cache_specs
 
 
 def decode_step(params: Params, cache: Params, lengths: jax.Array,

@@ -113,7 +113,8 @@ class ModelServer:
                  temperature: float = 0.0,
                  quantize: Optional[str] = None,
                  tp: int = 1,
-                 hf_model: Optional[str] = None):
+                 hf_model: Optional[str] = None,
+                 kv_quantize: Optional[str] = None):
         params = None
         eos_id = EOS_ID
         if hf_model is not None:
@@ -157,7 +158,7 @@ class ModelServer:
             engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
                 eos_id=eos_id, temperature=temperature,
-                quantize=quantize))
+                quantize=quantize, kv_quantize=kv_quantize))
         self.port = port
         self.ready = threading.Event()
         self.request_queue: queue.Queue = queue.Queue()
@@ -583,6 +584,9 @@ def main() -> None:
     parser.add_argument('--quantize', choices=['int8'], default=None,
                         help='weight-only quantization (halves weight '
                              'HBM traffic; decode is weight-bound)')
+    parser.add_argument('--kv-quantize', choices=['int8'], default=None,
+                        help='int8 KV cache: halves cache HBM traffic '
+                             'and residency (~2x decode slots per chip)')
     parser.add_argument('--tp', type=int, default=1,
                         help='tensor-parallel degree: shard the model '
                              'over this many chips (one SPMD program, '
@@ -597,7 +601,8 @@ def main() -> None:
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
-                args.quantize, args.tp, args.hf_model).serve_forever()
+                args.quantize, args.tp, args.hf_model,
+                args.kv_quantize).serve_forever()
 
 
 if __name__ == '__main__':

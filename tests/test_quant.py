@@ -152,3 +152,78 @@ def test_int8_with_ep_tp_mixtral_mesh():
     prompts = [[3, 17, 99], [5, 9]]
     assert (sharded.generate_batch(prompts, max_new_tokens=5)
             == single.generate_batch(prompts, max_new_tokens=5))
+
+
+# ------------------------------------------------------------------ #
+# int8 KV cache
+# ------------------------------------------------------------------ #
+
+def test_kv_cache_int8_structure_and_specs():
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.ops import quant
+    cfg = llama.llama_tiny()
+    cache = llama.init_kv_cache(cfg, 2, 16, quantized=True)
+    assert isinstance(cache['k'], quant.QTensor)
+    assert cache['k'].q.dtype == jnp.int8
+    assert cache['k'].q.shape == (cfg.n_layers, 2, 16, cfg.n_kv_heads,
+                                  cfg.head_dim)
+    assert cache['k'].scale.shape == (cfg.n_layers, 2, 16,
+                                      cfg.n_kv_heads)
+    import jax
+    specs = llama.kv_cache_specs(quantized=True)
+    assert (jax.tree_util.tree_structure(specs)
+            == jax.tree_util.tree_structure(cache))
+
+
+def test_kv_int8_decode_close_to_bf16_cache():
+    """int8 KV cache must reproduce the bf16-cache greedy decode on a
+    real (tiny, fp32-weight) model — per-token scales keep attention
+    reads accurate enough that argmax decisions agree."""
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import engine as engine_lib
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+
+    def decode(kv_quantize):
+        eng = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=2, max_decode_len=64, prefill_buckets=(16,),
+                kv_quantize=kv_quantize))
+        return eng.generate_batch([[7, 3, 9, 1], [5, 5, 2]],
+                                  max_new_tokens=12)
+
+    assert decode(None) == decode('int8')
+
+
+def test_kv_int8_composes_with_weight_int8_and_chunked_decode():
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import engine as engine_lib
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0,
+        dtype=jnp.bfloat16, remat=False, use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(16,),
+            decode_chunk=4, quantize='int8', kv_quantize='int8'))
+    [a, b] = eng.generate_batch([[7, 3, 9, 1], [5, 5, 2]],
+                                max_new_tokens=9)
+    assert len(a) == 9 and len(b) == 9
+
+
+def test_kv_int8_mixtral():
+    import jax.numpy as jnp
+    from skypilot_tpu.models import mixtral
+    from skypilot_tpu.serve import engine as engine_lib
+    cfg = mixtral.mixtral_tiny()
+    eng = engine_lib.Engine(
+        cfg, model=mixtral, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(16,),
+            kv_quantize='int8'))
+    [out] = eng.generate_batch([[7, 3, 9]], max_new_tokens=5)
+    assert len(out) == 5
