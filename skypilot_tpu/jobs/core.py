@@ -153,6 +153,14 @@ def launch(task_or_dag, name: Optional[str] = None,
     if controller not in ('local', 'vm'):
         raise exceptions.NotSupportedError(
             f"controller must be 'local' or 'vm', got {controller!r}")
+    if len(dag.tasks) > 1:
+        # DAG-level placement BEFORE serialization: the egress-aware
+        # pass pins co-located children into task.resources, which is
+        # what survives the dag YAML round trip (the controller
+        # re-optimizes each task independently and honors region pins).
+        from skypilot_tpu import optimizer
+        dag.resolve_edges()
+        optimizer.optimize(dag, quiet=True)
     if controller == 'vm':
         return _launch_on_controller_vm(dag, job_name, detach)
 

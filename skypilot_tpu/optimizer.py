@@ -102,55 +102,70 @@ def _apply_egress_placement(dag: dag_lib.Dag,
     region differs from its parent's and the parent declares
     `outputs: {estimated_size_gb: N}`, re-pin the child to the parent's
     region if hourly-price-delta x runtime < one-off egress cost.
-    Mutates the plans' best_resources/candidates in place. Edges are
-    processed parents-first (topological order), so a parent's own
-    placement is FINAL before any of its children co-locate with it —
-    declaration-order processing could pin a child to a region its
-    parent later leaves."""
+    For each child the decision is made ONCE over all its parents
+    (candidate regions scored by run-cost PLUS total egress from every
+    data-bearing parent), children in topological order so a parent's
+    placement is final before its children look at it — per-edge greedy
+    would let a second parent re-move a child and silently re-incur the
+    first parent's egress. The winning region is ALSO pinned into
+    task.resources (the durable spec): managed jobs re-optimize each
+    task independently on the controller (execution.launch), and only
+    the spec-level pin survives the dag YAML round trip."""
     plan_by_task = {id(p.task): p for p in plans}
-    topo_idx = {id(t): i for i, t in enumerate(dag.topological_order())}
-    for parent, child in sorted(dag.edges(),
-                                key=lambda e: topo_idx[id(e[0])]):
-        out_gb = parent.estimated_output_gb
-        if not out_gb:
+    by_child: dict = {}
+    for parent, child in dag.edges():
+        if parent.estimated_output_gb:
+            by_child.setdefault(id(child), []).append(parent)
+    for child in dag.topological_order():
+        parents = by_child.get(id(child))
+        if not parents:
             continue
-        p_plan = plan_by_task[id(parent)]
         c_plan = plan_by_task[id(child)]
-        p_region = p_plan.task.best_resources.region
-        c_res = c_plan.task.best_resources
-        if (c_res.region == p_region
-                or c_plan.task.resources.region is not None):
-            continue   # already co-located, or user pinned the region
-        same_region = [o for o in c_plan.candidates
-                       if o.region == p_region]
-        if not same_region:
-            continue
-        egress_cost = out_gb * EGRESS_USD_PER_GB
+        if c_plan.task.resources.region is not None:
+            continue   # user pinned the region — always wins
         use_spot = c_plan.task.resources.use_spot
         n = c_plan.task.num_nodes
-        delta_hr = (same_region[0].price(use_spot)
-                    - c_plan.chosen.price(use_spot)) * n
-        if delta_hr * DEFAULT_RUNTIME_HOURS < egress_cost:
-            chosen = same_region[0]
-            c_plan.chosen = chosen
-            # Failover still roams: co-located candidates first.
-            c_plan.candidates = same_region + [
-                o for o in c_plan.candidates if o not in same_region]
-            # Rebuild best_resources FROM the new offering (mirror of
-            # optimize_task): region alone is not enough — the cheapest
-            # same-region candidate may be a different shape.
-            if hasattr(chosen, 'topology'):
-                c_plan.task.best_resources = c_res.copy(
-                    tpu=chosen.topology, region=p_region)
-            else:
-                c_plan.task.best_resources = c_res.copy(
-                    instance_type=chosen.instance_type, region=p_region)
-            c_plan.hourly_cost = chosen.price(use_spot) * n
-            logger.info(
-                'egress-aware placement: %r moved to region %s '
-                '(parent %r hands it %.0f GB; egress $%.2f > '
-                'price delta $%.3f/h)', child.name, p_region,
-                parent.name, out_gb, egress_cost, delta_hr)
+
+        def egress_to(region):
+            return sum(p.estimated_output_gb * EGRESS_USD_PER_GB
+                       for p in parents
+                       if plan_by_task[id(p)].task.best_resources.region
+                       != region)
+
+        cheapest_in = {}
+        for o in c_plan.candidates:          # price-ascending
+            cheapest_in.setdefault(o.region, o)
+        best = min(
+            cheapest_in.values(),
+            key=lambda o: (o.price(use_spot) * n * DEFAULT_RUNTIME_HOURS
+                           + egress_to(o.region)))
+        if best.region == c_plan.task.best_resources.region:
+            continue
+        same_region = [o for o in c_plan.candidates
+                       if o.region == best.region]
+        c_plan.chosen = best
+        # Failover still roams: co-located candidates first.
+        c_plan.candidates = same_region + [
+            o for o in c_plan.candidates if o not in same_region]
+        # Rebuild best_resources FROM the new offering (mirror of
+        # optimize_task): region alone is not enough — the cheapest
+        # same-region candidate may be a different shape.
+        c_res = c_plan.task.best_resources
+        if hasattr(best, 'topology'):
+            c_plan.task.best_resources = c_res.copy(
+                tpu=best.topology, region=best.region)
+        else:
+            c_plan.task.best_resources = c_res.copy(
+                instance_type=best.instance_type, region=best.region)
+        # Durable pin (see docstring).
+        c_plan.task.resources = c_plan.task.resources.copy(
+            region=best.region)
+        c_plan.hourly_cost = best.price(use_spot) * n
+        logger.info(
+            'egress-aware placement: %r pinned to region %s (%d '
+            'data-bearing parent(s); total remaining egress $%.2f)',
+            child.name, best.region, len(parents),
+            egress_to(best.region))
 
 
 def optimize(dag: dag_lib.Dag,

@@ -158,3 +158,46 @@ def test_duplicate_referenced_name_rejected():
     dag.add(_task('eval', ['train']))
     with pytest.raises(exceptions.InvalidTaskError, match='duplicate'):
         dag.resolve_edges()
+
+
+def test_multi_parent_egress_minimizes_total():
+    """Diamond: both b (us-west1) and c (us-east1) hand d 100 GB. d
+    must land on ONE parent's region (egress $1) — never a third
+    region that pays both parents' egress ($2) at the same price."""
+    dag = dag_lib.Dag()
+    a = _task('a')
+    b = _task('b', ['a'], out_gb=100, region='us-west1')
+    c = _task('c', ['a'], out_gb=100, region='us-east1')
+    d = _task('d', ['b', 'c'])
+    for t in (a, b, c, d):
+        dag.add(t)
+    plans = optimizer.optimize(dag, quiet=True)
+    by_name = {p.task.name: p for p in plans}
+    assert by_name['d'].task.best_resources.region in ('us-west1',
+                                                       'us-east1')
+
+
+def test_egress_pin_survives_managed_job_serialization(monkeypatch):
+    """The co-location decision must reach the CONTROLLER, which
+    re-optimizes each task independently: the dag YAML it reads must
+    carry the region pin on the child task."""
+    monkeypatch.setenv('SKYT_JOBS_POLL_SECONDS', '0.5')
+    import yaml as yaml_lib
+
+    from skypilot_tpu.jobs import core as jobs_core
+    from skypilot_tpu.jobs import state
+    dag = dag_lib.Dag(name='egressjob')
+    dag.add(_task('train', out_gb=100, region='us-west1'))
+    dag.add(_task('eval', ['train']))
+    job_id = jobs_core.launch(dag)
+    with open(state.get_job(job_id)['dag_yaml']) as f:
+        docs = list(yaml_lib.safe_load_all(f))
+    eval_doc = next(d for d in docs if d['name'] == 'eval')
+    assert eval_doc['resources'].get('region') == 'us-west1'
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        s = state.get_job(job_id)['status'].value
+        if s in ('SUCCEEDED', 'FAILED', 'FAILED_CONTROLLER'):
+            break
+        time.sleep(0.3)
+    assert s == 'SUCCEEDED', s
