@@ -52,7 +52,14 @@ def _rope_scaling_from_hf(hf_config: Any):
 
 def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
                    **overrides) -> llama.LlamaConfig:
-    """LlamaConfig from a transformers LlamaConfig."""
+    """LlamaConfig from a transformers Llama/Qwen2 config. Qwen2
+    ALWAYS carries q/k/v biases (Qwen2Attention hardcodes them —
+    a stray 'attention_bias: false' in a re-uploaded config.json must
+    not drop real weights); HF Llama's attention_bias additionally
+    biases o_proj."""
+    is_qwen2 = hf_config.model_type == 'qwen2'
+    declared = bool(getattr(hf_config, 'attention_bias', False))
+    attn_bias = is_qwen2 or declared
     kw = dict(
         vocab_size=hf_config.vocab_size,
         dim=hf_config.hidden_size,
@@ -64,6 +71,8 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         rope_theta=float(hf_config.rope_theta),
         norm_eps=float(hf_config.rms_norm_eps),
         rope_scaling=_rope_scaling_from_hf(hf_config),
+        attention_bias=attn_bias,
+        attention_out_bias=declared and not is_qwen2,
         dtype=dtype,
     )
     kw.update(overrides)
@@ -73,12 +82,11 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
 def _check_supported(hcfg: Any) -> None:
     """Raise on config features we would otherwise silently drop
     (same convention as _rope_scaling_from_hf: wrong-logits bugs must
-    be loud)."""
-    if getattr(hcfg, 'attention_bias', False):
-        raise NotImplementedError(
-            'attention_bias=True checkpoints are not supported (q/k/v/o '
-            'biases are not modeled)')
-    if getattr(hcfg, 'sliding_window', None):
+    be loud). Attention biases ARE modeled: q/k/v for the Qwen2 family
+    and HF-Llama attention_bias checkpoints, o_proj for the latter
+    (LlamaConfig.attention_bias / .attention_out_bias)."""
+    if getattr(hcfg, 'sliding_window', None) and getattr(
+            hcfg, 'use_sliding_window', True):
         raise NotImplementedError(
             f'sliding_window={hcfg.sliding_window} is not supported '
             '(attention is global-causal)')
@@ -98,10 +106,13 @@ def _stack(sd: Any, n_layers: int, dtype: Any, fmt: str,
                      for i in range(n_layers)]).astype(dtype)
 
 
-def _attention_and_norms(sd: Any, n_layers: int, dtype: Any):
-    """The layer leaves Llama and Mixtral share (attention + norms)."""
+def _attention_and_norms(sd: Any, n_layers: int, dtype: Any,
+                         attention_bias: bool = False,
+                         attention_out_bias: bool = False):
+    """The layer leaves Llama/Qwen2 and Mixtral share (attention +
+    norms; q/k/v and o biases when the family has them)."""
     stack = functools.partial(_stack, sd, n_layers, dtype)
-    return {
+    out = {
         'wq': stack('model.layers.{}.self_attn.q_proj.weight',
                     transpose=True),
         'wk': stack('model.layers.{}.self_attn.k_proj.weight',
@@ -114,6 +125,15 @@ def _attention_and_norms(sd: Any, n_layers: int, dtype: Any):
         'ln_mlp': stack(
             'model.layers.{}.post_attention_layernorm.weight'),
     }
+    if attention_bias:
+        out.update({
+            'bq': stack('model.layers.{}.self_attn.q_proj.bias'),
+            'bk': stack('model.layers.{}.self_attn.k_proj.bias'),
+            'bv': stack('model.layers.{}.self_attn.v_proj.bias'),
+        })
+    if attention_out_bias:
+        out['bo'] = stack('model.layers.{}.self_attn.o_proj.bias')
+    return out
 
 
 def _embed_and_lm_head(sd: Any, hcfg: Any, dtype: Any):
@@ -128,10 +148,11 @@ def _embed_and_lm_head(sd: Any, hcfg: Any, dtype: Any):
 def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
                   **config_overrides
                   ) -> Tuple[llama.LlamaConfig, llama.Params]:
-    """Convert a transformers LlamaForCausalLM (torch) to
-    (LlamaConfig, params). `config_overrides` tweak the resulting
-    config (e.g. use_flash_attention=False for CPU tests). Params are
-    HOST numpy arrays (see _arr)."""
+    """Convert a transformers LlamaForCausalLM OR Qwen2ForCausalLM
+    (torch) to (LlamaConfig, params) — Qwen2 is the Llama architecture
+    plus q/k/v biases. `config_overrides` tweak the resulting config
+    (e.g. use_flash_attention=False for CPU tests). Params are HOST
+    numpy arrays (see _arr)."""
     _check_supported(hf_model.config)
     cfg = config_from_hf(hf_model.config, dtype=dtype,
                          **config_overrides)
@@ -139,7 +160,9 @@ def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
     stack = functools.partial(_stack, sd, cfg.n_layers, dtype)
     embed, lm_head = _embed_and_lm_head(sd, hf_model.config, dtype)
 
-    layers = _attention_and_norms(sd, cfg.n_layers, dtype)
+    layers = _attention_and_norms(
+        sd, cfg.n_layers, dtype, attention_bias=cfg.attention_bias,
+        attention_out_bias=cfg.attention_out_bias)
     layers.update({
         'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
                         transpose=True),
@@ -234,15 +257,17 @@ def from_hf_auto(path: str, dtype: Any = jnp.bfloat16,
         from skypilot_tpu.models import mixtral as model_module
         cfg, params = from_hf_mixtral(hf, dtype=dtype,
                                       **config_overrides)
-    elif model_type == 'llama':
-        hf = transformers.LlamaForCausalLM.from_pretrained(
+    elif model_type in ('llama', 'qwen2'):
+        loader = (transformers.LlamaForCausalLM if model_type == 'llama'
+                  else transformers.Qwen2ForCausalLM)
+        hf = loader.from_pretrained(
             path, torch_dtype='auto', low_cpu_mem_usage=True)
         from skypilot_tpu.models import llama as model_module
         cfg, params = from_hf_llama(hf, dtype=dtype, **config_overrides)
     else:
         raise ValueError(
             f'unsupported HF model_type {model_type!r} '
-            "(supported: 'llama', 'mixtral')")
+            "(supported: 'llama', 'qwen2', 'mixtral')")
     eos = hf.config.eos_token_id
     del hf
     if isinstance(eos, (list, tuple)):

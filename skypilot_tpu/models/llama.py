@@ -68,6 +68,12 @@ class LlamaConfig:
     scan_layers: bool = True
     # Llama-3.1 long-context RoPE scaling (None = plain rope_theta).
     rope_scaling: Optional[RopeScaling] = None
+    # Q/K/V projection biases (Qwen2-family checkpoints; Llama
+    # declares attention_bias in its HF config). Adds bq/bk/bv leaves.
+    attention_bias: bool = False
+    # Output-projection bias: HF Llama with attention_bias=True also
+    # biases o_proj; Qwen2 biases ONLY q/k/v. Adds a bo leaf.
+    attention_out_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -84,6 +90,10 @@ class LlamaConfig:
                      + d * d        # wo
                      + 3 * d * f    # gate, up, down
                      + 2 * d)       # norms
+        if self.attention_bias:
+            per_layer += d + 2 * kvd   # bq, bk, bv
+        if self.attention_out_bias:
+            per_layer += d             # bo
         return v * d * 2 + l * per_layer + d
 
     def flops_per_token(self, seq_len: int) -> float:
@@ -122,19 +132,28 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         return (jax.random.normal(k, shape, jnp.float32) /
                 jnp.sqrt(fan_in)).astype(cfg.dtype)
 
+    layers = {
+        'wq': norm_init(keys[1], (l, d, nh * hd), d),
+        'wk': norm_init(keys[2], (l, d, nkv * hd), d),
+        'wv': norm_init(keys[3], (l, d, nkv * hd), d),
+        'wo': norm_init(keys[4], (l, nh * hd, d), nh * hd),
+        'w_gate': norm_init(keys[5], (l, d, f), d),
+        'w_up': norm_init(keys[6], (l, d, f), d),
+        'w_down': norm_init(keys[7], (l, f, d), f),
+        'ln_attn': jnp.ones((l, d), cfg.dtype),
+        'ln_mlp': jnp.ones((l, d), cfg.dtype),
+    }
+    if cfg.attention_bias:
+        layers.update({
+            'bq': jnp.zeros((l, nh * hd), cfg.dtype),
+            'bk': jnp.zeros((l, nkv * hd), cfg.dtype),
+            'bv': jnp.zeros((l, nkv * hd), cfg.dtype),
+        })
+    if cfg.attention_out_bias:
+        layers['bo'] = jnp.zeros((l, d), cfg.dtype)
     return {
         'embed': norm_init(keys[0], (v, d), d),
-        'layers': {
-            'wq': norm_init(keys[1], (l, d, nh * hd), d),
-            'wk': norm_init(keys[2], (l, d, nkv * hd), d),
-            'wv': norm_init(keys[3], (l, d, nkv * hd), d),
-            'wo': norm_init(keys[4], (l, nh * hd, d), nh * hd),
-            'w_gate': norm_init(keys[5], (l, d, f), d),
-            'w_up': norm_init(keys[6], (l, d, f), d),
-            'w_down': norm_init(keys[7], (l, f, d), f),
-            'ln_attn': jnp.ones((l, d), cfg.dtype),
-            'ln_mlp': jnp.ones((l, d), cfg.dtype),
-        },
+        'layers': layers,
         'final_norm': jnp.ones((d,), cfg.dtype),
         'lm_head': norm_init(keys[8], (v, d), d),
     }
@@ -191,20 +210,25 @@ def param_shardings(cfg: LlamaConfig) -> Params:
     fsdp shards the model dim, tp shards heads/ffn (megatron: column-then-
     row so each block needs one reduce per projection pair).
     """
-    del cfg
+    layers = {
+        'wq': P(None, 'fsdp', 'tp'),
+        'wk': P(None, 'fsdp', 'tp'),
+        'wv': P(None, 'fsdp', 'tp'),
+        'wo': P(None, 'tp', 'fsdp'),
+        'w_gate': P(None, 'fsdp', 'tp'),
+        'w_up': P(None, 'fsdp', 'tp'),
+        'w_down': P(None, 'tp', 'fsdp'),
+        'ln_attn': P(None, None),
+        'ln_mlp': P(None, None),
+    }
+    if cfg.attention_bias:
+        layers.update({'bq': P(None, 'tp'), 'bk': P(None, 'tp'),
+                       'bv': P(None, 'tp')})
+    if cfg.attention_out_bias:
+        layers['bo'] = P(None, 'fsdp')
     return {
         'embed': P('tp', 'fsdp'),
-        'layers': {
-            'wq': P(None, 'fsdp', 'tp'),
-            'wk': P(None, 'fsdp', 'tp'),
-            'wv': P(None, 'fsdp', 'tp'),
-            'wo': P(None, 'tp', 'fsdp'),
-            'w_gate': P(None, 'fsdp', 'tp'),
-            'w_up': P(None, 'fsdp', 'tp'),
-            'w_down': P(None, 'tp', 'fsdp'),
-            'ln_attn': P(None, None),
-            'ln_mlp': P(None, None),
-        },
+        'layers': layers,
         'final_norm': P(None),
         'lm_head': P('tp', 'fsdp'),
     }
@@ -356,9 +380,16 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     attn_in = rms_norm(x, layer_params['ln_attn'], cfg.norm_eps)
-    q = quant.qdot(attn_in, layer_params['wq']).reshape(b, s, h, hd)
-    k = quant.qdot(attn_in, layer_params['wk']).reshape(b, s, kv, hd)
-    v = quant.qdot(attn_in, layer_params['wv']).reshape(b, s, kv, hd)
+    q = quant.qdot(attn_in, layer_params['wq'])
+    k = quant.qdot(attn_in, layer_params['wk'])
+    v = quant.qdot(attn_in, layer_params['wv'])
+    if 'bq' in layer_params:      # Qwen2-style q/k/v biases
+        q = q + layer_params['bq']
+        k = k + layer_params['bk']
+        v = v + layer_params['bv']
+    q = q.reshape(b, s, h, hd)
+    k = k.reshape(b, s, kv, hd)
+    v = v.reshape(b, s, kv, hd)
     q = apply_rope(q, angles)
     k = apply_rope(k, angles)
     if cache is not None:
@@ -372,7 +403,10 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     else:
         attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
         kv_out = (k, v) if return_kv else None
-    x = x + quant.qdot(attn_out, layer_params['wo'])
+    proj = quant.qdot(attn_out, layer_params['wo'])
+    if 'bo' in layer_params:      # HF Llama attention_bias o_proj bias
+        proj = proj + layer_params['bo']
+    x = x + proj
     return _shard(x, ACT_SPEC), kv_out
 
 

@@ -192,3 +192,115 @@ def test_finetune_from_hf_checkpoint():
     want0 = float(trainer.cross_entropy_loss(
         llama.forward(params, tokens[:, :-1], cfg), tokens[:, 1:]))
     np.testing.assert_allclose(losses[0], want0, rtol=1e-4)
+
+
+# ------------------------------------------------------------------ #
+# Qwen2 family (Llama architecture + q/k/v biases)
+# ------------------------------------------------------------------ #
+
+def _tiny_qwen2():
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, use_sliding_window=False,
+        attn_implementation='eager')
+    torch.manual_seed(3)
+    model = transformers.Qwen2ForCausalLM(hf_cfg)
+    model.eval()
+    return model
+
+
+def test_qwen2_forward_matches_transformers():
+    """Qwen2's q/k/v biases must be loaded and applied — dropping them
+    silently would shift every attention score. (Fresh-initialized
+    biases are zero, so perturb them first: the comparison must
+    actually exercise the adds.)"""
+    hf_model = _tiny_qwen2()
+    with torch.no_grad():
+        for layer in hf_model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.add_(torch.randn_like(proj.bias) * 0.5)
+    cfg, params = hf_convert.from_hf_llama(
+        hf_model, dtype=jnp.float32, remat=False,
+        use_flash_attention=False)
+    assert cfg.attention_bias and not cfg.attention_out_bias
+    assert 'bq' in params['layers'] and 'bo' not in params['layers']
+    tokens = np.array([[3, 17, 99, 42, 7, 11]], np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens).long()).logits.numpy()
+    got = np.asarray(llama.forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_attention_bias_includes_o_proj():
+    """HF Llama with attention_bias=True biases o_proj TOO — a
+    conversion that loads only q/k/v biases is silently offset-wrong
+    in every layer."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5, attention_bias=True,
+        attn_implementation='eager')
+    torch.manual_seed(5)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg)
+    hf_model.eval()
+    with torch.no_grad():
+        for layer in hf_model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.add_(torch.randn_like(proj.bias) * 0.5)
+    cfg, params = hf_convert.from_hf_llama(
+        hf_model, dtype=jnp.float32, remat=False,
+        use_flash_attention=False)
+    assert cfg.attention_bias and cfg.attention_out_bias
+    assert 'bo' in params['layers']
+    tokens = np.array([[3, 17, 99, 42, 7, 11]], np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(tokens).long()).logits.numpy()
+    got = np.asarray(llama.forward(params, jnp.asarray(tokens), cfg))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_serves_and_matches_torch_greedy():
+    from skypilot_tpu.serve import engine as engine_lib
+    hf_model = _tiny_qwen2()
+    cfg, params = hf_convert.from_hf_llama(
+        hf_model, dtype=jnp.float32, remat=False,
+        use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, params,
+        engine_lib.EngineConfig(batch_size=2, max_decode_len=64,
+                                prefill_buckets=(8, 16)))
+    prompt = [3, 17, 99, 42, 7]
+    [got] = eng.generate_batch([prompt], max_new_tokens=6)
+    toks = list(prompt)
+    want = []
+    with torch.no_grad():
+        for _ in range(6):
+            logits = hf_model(
+                torch.tensor([toks]).long()).logits[0, -1].numpy()
+            nxt = int(np.argmax(logits))
+            want.append(nxt)
+            toks.append(nxt)
+    assert got == want
+
+
+def test_qwen2_from_hf_auto_and_tp_shardings(tmp_path):
+    """Auto-detection by model_type, and the bias leaves carry tp
+    specs so Qwen2 serves tensor-parallel like Llama."""
+    import jax
+    hf_model = _tiny_qwen2()
+    hf_model.save_pretrained(str(tmp_path))
+    module, cfg, params, eos = hf_convert.from_hf_auto(
+        str(tmp_path), dtype=jnp.float32,
+        use_flash_attention=False, remat=False)
+    assert module is llama and cfg.attention_bias
+    specs = llama.param_shardings(cfg)
+    assert (jax.tree_util.tree_structure(specs)
+            == jax.tree_util.tree_structure(
+                jax.tree.map(lambda x: 0, params)))
+    assert specs['layers']['bq'] is not None
