@@ -227,3 +227,25 @@ def test_kv_int8_mixtral():
             kv_quantize='int8'))
     [out] = eng.generate_batch([[7, 3, 9]], max_new_tokens=5)
     assert len(out) == 5
+
+
+def test_kv_int8_over_tp_mesh():
+    """int8 KV cache composes with tensor-parallel serving: the QTensor
+    spec tree (kv_cache_specs) shards q AND scale over 'tp'."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    from skypilot_tpu.serve import engine as engine_lib
+    mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=2),
+                              devices=jax.devices()[:2])
+    cfg = llama.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0,
+        dtype=jnp.bfloat16, remat=False, use_flash_attention=False)
+    eng = engine_lib.Engine(
+        cfg, mesh=mesh, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=32, prefill_buckets=(8,),
+            quantize='int8', kv_quantize='int8'))
+    [out] = eng.generate_batch([[5, 9, 23]], max_new_tokens=4)
+    assert len(out) == 4
