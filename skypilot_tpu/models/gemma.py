@@ -1,0 +1,56 @@
+"""Gemma family — expressed on the shared Llama-lineage engine.
+
+The reference serves/fine-tunes Gemma via external recipes (reference
+llm/gemma/README.md shells out to vLLM/HF); here Gemma is the same
+in-framework model as Llama/Qwen2 (models/llama.py) with its four
+architectural deltas expressed as config knobs + load-time folding:
+
+  * explicit head_dim (gemma-7b: 16 heads x 256 > dim 3072) —
+    LlamaConfig.head_dim_override;
+  * GELU(tanh) MLP instead of SiLU — mlp_act='gelu_tanh';
+  * input embeddings scaled by sqrt(dim) — embed_scale;
+  * RMSNorm multiplies by (1 + w) — folded into the stored norm
+    weights at conversion (models/hf_convert.from_hf_gemma), so the
+    runtime norm stays the shared llama.rms_norm;
+  * lm_head tied to the embedding (always, both sizes).
+
+Everything else — KV-cache serving engine, int8 weight/KV quant,
+tensor-parallel shardings, trainer — is inherited unchanged.
+"""
+from __future__ import annotations
+
+import math
+
+from skypilot_tpu.models.llama import (    # noqa: F401 — re-exports:
+    LlamaConfig, decode_step, forward, init_kv_cache, init_params,
+    kv_cache_specs, param_shardings, quantize_params,
+    quantized_param_shardings)
+
+
+def gemma_7b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=256000, dim=3072, n_layers=28, n_heads=16,
+        n_kv_heads=16, head_dim_override=256, ffn_dim=24576,
+        max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        mlp_act='gelu_tanh', embed_scale=math.sqrt(3072.0),
+        tied_embeddings=True)
+
+
+def gemma_2b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab_size=256000, dim=2048, n_layers=18, n_heads=8,
+        n_kv_heads=1, head_dim_override=256, ffn_dim=16384,
+        max_seq_len=8192, rope_theta=10000.0, norm_eps=1e-6,
+        mlp_act='gelu_tanh', embed_scale=math.sqrt(2048.0),
+        tied_embeddings=True)
+
+
+def gemma_tiny() -> LlamaConfig:
+    """Structure-preserving toy config (incl. head_dim != dim/heads and
+    MQA) for tests / compile checks."""
+    return LlamaConfig(
+        vocab_size=512, dim=96, n_layers=2, n_heads=4, n_kv_heads=1,
+        head_dim_override=32, ffn_dim=256, max_seq_len=512,
+        rope_theta=10000.0, norm_eps=1e-6, mlp_act='gelu_tanh',
+        embed_scale=math.sqrt(96.0), tied_embeddings=True,
+        use_flash_attention=False)

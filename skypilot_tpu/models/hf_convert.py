@@ -73,6 +73,8 @@ def config_from_hf(hf_config: Any, dtype: Any = jnp.bfloat16,
         rope_scaling=_rope_scaling_from_hf(hf_config),
         attention_bias=attn_bias,
         attention_out_bias=declared and not is_qwen2,
+        tied_embeddings=bool(getattr(hf_config, 'tie_word_embeddings',
+                                     False)),
         dtype=dtype,
     )
     kw.update(overrides)
@@ -145,6 +147,19 @@ def _embed_and_lm_head(sd: Any, hcfg: Any, dtype: Any):
     return embed, lm_head
 
 
+def _dense_mlp(stack) -> dict:
+    """gate/up/down leaves shared by the dense-FFN families
+    (Llama/Qwen2/Gemma)."""
+    return {
+        'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
+                        transpose=True),
+        'w_up': stack('model.layers.{}.mlp.up_proj.weight',
+                      transpose=True),
+        'w_down': stack('model.layers.{}.mlp.down_proj.weight',
+                        transpose=True),
+    }
+
+
 def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
                   **config_overrides
                   ) -> Tuple[llama.LlamaConfig, llama.Params]:
@@ -163,19 +178,80 @@ def from_hf_llama(hf_model: Any, dtype: Any = jnp.bfloat16,
     layers = _attention_and_norms(
         sd, cfg.n_layers, dtype, attention_bias=cfg.attention_bias,
         attention_out_bias=cfg.attention_out_bias)
-    layers.update({
-        'w_gate': stack('model.layers.{}.mlp.gate_proj.weight',
-                        transpose=True),
-        'w_up': stack('model.layers.{}.mlp.up_proj.weight',
-                      transpose=True),
-        'w_down': stack('model.layers.{}.mlp.down_proj.weight',
-                        transpose=True),
-    })
+    layers.update(_dense_mlp(stack))
     params = {
         'embed': embed,
         'layers': layers,
         'final_norm': _arr(sd, 'model.norm.weight').astype(dtype),
         'lm_head': lm_head,
+    }
+    return cfg, params
+
+
+def from_hf_gemma(hf_model: Any, dtype: Any = jnp.bfloat16,
+                  **config_overrides
+                  ) -> Tuple[llama.LlamaConfig, llama.Params]:
+    """Convert a transformers GemmaForCausalLM to (LlamaConfig, params)
+    on the shared Llama-lineage engine (models/gemma.py): explicit
+    head_dim, gelu_tanh MLP, sqrt(dim) embedding scale, and Gemma's
+    (1 + w) RMSNorm FOLDED into the stored norm weights so the runtime
+    norm is the shared llama.rms_norm. lm_head is always tied."""
+    hcfg = hf_model.config
+    _check_supported(hcfg)
+    act = getattr(hcfg, 'hidden_activation', None) or getattr(
+        hcfg, 'hidden_act', 'gelu_pytorch_tanh')
+    if act not in ('gelu', 'gelu_pytorch_tanh'):
+        raise NotImplementedError(
+            f'Gemma hidden activation {act!r} is not supported')
+    # Loud on anything we would silently drop (the module convention):
+    # stock Gemma has none of these, but re-uploaded fine-tunes can.
+    if getattr(hcfg, 'attention_bias', False):
+        raise NotImplementedError(
+            'Gemma checkpoints with attention_bias=True are not '
+            'supported (bias weights would be dropped)')
+    if not getattr(hcfg, 'tie_word_embeddings', True):
+        raise NotImplementedError(
+            'Gemma checkpoints with untied lm_head are not supported '
+            '(the separate lm_head.weight would be dropped)')
+    if _rope_scaling_from_hf(hcfg) is not None:
+        raise NotImplementedError(
+            'Gemma checkpoints with rope_scaling are not supported')
+    import math
+    kw = dict(
+        vocab_size=hcfg.vocab_size,
+        dim=hcfg.hidden_size,
+        n_layers=hcfg.num_hidden_layers,
+        n_heads=hcfg.num_attention_heads,
+        n_kv_heads=hcfg.num_key_value_heads,
+        head_dim_override=hcfg.head_dim,
+        ffn_dim=hcfg.intermediate_size,
+        max_seq_len=hcfg.max_position_embeddings,
+        rope_theta=float(hcfg.rope_theta),
+        norm_eps=float(hcfg.rms_norm_eps),
+        mlp_act='gelu_tanh',
+        embed_scale=math.sqrt(float(hcfg.hidden_size)),
+        tied_embeddings=True,
+        dtype=dtype,
+    )
+    kw.update(config_overrides)
+    cfg = llama.LlamaConfig(**kw)
+    sd = hf_model.state_dict()
+    stack = functools.partial(_stack, sd, cfg.n_layers, dtype)
+    embed = _arr(sd, 'model.embed_tokens.weight').astype(dtype)
+
+    layers = _attention_and_norms(sd, cfg.n_layers, dtype)
+    # (1 + w) -> stored as w + 1 (fp32 add before the dtype cast).
+    for name in ('ln_attn', 'ln_mlp'):
+        layers[name] = (layers[name].astype(np.float32)
+                        + 1.0).astype(dtype)
+    layers.update(_dense_mlp(stack))
+    final_norm = (_arr(sd, 'model.norm.weight').astype(np.float32)
+                  + 1.0).astype(dtype)
+    params = {
+        'embed': embed,
+        'layers': layers,
+        'final_norm': final_norm,
+        'lm_head': embed,        # always tied
     }
     return cfg, params
 
@@ -264,10 +340,15 @@ def from_hf_auto(path: str, dtype: Any = jnp.bfloat16,
             path, torch_dtype='auto', low_cpu_mem_usage=True)
         from skypilot_tpu.models import llama as model_module
         cfg, params = from_hf_llama(hf, dtype=dtype, **config_overrides)
+    elif model_type == 'gemma':
+        hf = transformers.GemmaForCausalLM.from_pretrained(
+            path, torch_dtype='auto', low_cpu_mem_usage=True)
+        from skypilot_tpu.models import llama as model_module
+        cfg, params = from_hf_gemma(hf, dtype=dtype, **config_overrides)
     else:
         raise ValueError(
             f'unsupported HF model_type {model_type!r} '
-            "(supported: 'llama', 'qwen2', 'mixtral')")
+            "(supported: 'llama', 'qwen2', 'gemma', 'mixtral')")
     eos = hf.config.eos_token_id
     del hf
     if isinstance(eos, (list, tuple)):

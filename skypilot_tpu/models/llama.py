@@ -74,27 +74,45 @@ class LlamaConfig:
     # Output-projection bias: HF Llama with attention_bias=True also
     # biases o_proj; Qwen2 biases ONLY q/k/v. Adds a bo leaf.
     attention_out_bias: bool = False
+    # Family knobs that make this config span the Llama lineage
+    # (Llama/Qwen2/Gemma — HF's modeling_llama descendants):
+    # explicit head_dim (Gemma: n_heads * head_dim != dim), MLP
+    # activation ('silu' | 'gelu_tanh'), and input-embedding scale
+    # (Gemma multiplies by sqrt(dim)). Gemma's (1+w) RMSNorm is folded
+    # into the stored weights at conversion time instead.
+    head_dim_override: Optional[int] = None
+    mlp_act: str = 'silu'
+    embed_scale: float = 1.0
+    # lm_head shares the embedding matrix (Gemma always; small
+    # Llama/Qwen2 checkpoints via tie_word_embeddings). Param/FLOP
+    # accounting counts the matrix once, and the engine keeps ONE
+    # device copy.
+    tied_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.dim // self.n_heads
 
     @property
     def num_params(self) -> int:
-        """Exact dense param count (embeddings counted once; lm_head
-        untied like Llama-3-8B)."""
+        """Exact dense param count (tied_embeddings counts the
+        embedding/lm_head matrix once)."""
         d, f, l, v = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
+        qd = self.n_heads * self.head_dim
         kvd = self.n_kv_heads * self.head_dim
-        per_layer = (d * d          # wq
+        per_layer = (d * qd         # wq
                      + 2 * d * kvd  # wk, wv
-                     + d * d        # wo
+                     + qd * d       # wo
                      + 3 * d * f    # gate, up, down
                      + 2 * d)       # norms
         if self.attention_bias:
-            per_layer += d + 2 * kvd   # bq, bk, bv
+            per_layer += qd + 2 * kvd  # bq, bk, bv
         if self.attention_out_bias:
             per_layer += d             # bo
-        return v * d * 2 + l * per_layer + d
+        embed_params = v * d * (1 if self.tied_embeddings else 2)
+        return embed_params + l * per_layer + d
 
     def flops_per_token(self, seq_len: int) -> float:
         """Training FLOPs/token: 6*N for matmuls + 12*L*D*S attention
@@ -112,6 +130,14 @@ def llama3_1b() -> LlamaConfig:
     """Llama-3.2-1B shape."""
     return LlamaConfig(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
                        ffn_dim=8192)
+
+
+def qwen2_7b() -> LlamaConfig:
+    """Qwen2/2.5-7B shape (q/k/v biases)."""
+    return LlamaConfig(vocab_size=152064, dim=3584, n_layers=28,
+                       n_heads=28, n_kv_heads=4, ffn_dim=18944,
+                       max_seq_len=32768, rope_theta=1e6,
+                       norm_eps=1e-6, attention_bias=True)
 
 
 def llama_tiny() -> LlamaConfig:
@@ -151,11 +177,13 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         })
     if cfg.attention_out_bias:
         layers['bo'] = jnp.zeros((l, d), cfg.dtype)
+    embed = norm_init(keys[0], (v, d), d)
     return {
-        'embed': norm_init(keys[0], (v, d), d),
+        'embed': embed,
         'layers': layers,
         'final_norm': jnp.ones((d,), cfg.dtype),
-        'lm_head': norm_init(keys[8], (v, d), d),
+        'lm_head': (embed if cfg.tied_embeddings
+                    else norm_init(keys[8], (v, d), d)),
     }
 
 
@@ -239,6 +267,24 @@ LOGITS_SPEC = P(('dp', 'fsdp'), 'sp', 'tp')       # [B, S, V]
 
 
 # Model --------------------------------------------------------------- #
+
+def _mlp_act(cfg: LlamaConfig):
+    if cfg.mlp_act == 'silu':
+        return jax.nn.silu
+    if cfg.mlp_act == 'gelu_tanh':      # Gemma
+        return functools.partial(jax.nn.gelu, approximate=True)
+    raise ValueError(f'unsupported mlp_act {cfg.mlp_act!r}')
+
+
+def _embed(params: Params, tokens: jax.Array,
+           cfg: LlamaConfig) -> jax.Array:
+    x = quant.qtake(params['embed'], tokens, cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        # Gemma scales input embeddings by sqrt(dim), with the factor
+        # rounded to the activation dtype (HF casts the normalizer).
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    return x
+
 
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     x32 = x.astype(jnp.float32)
@@ -363,7 +409,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
                                 return_kv=return_kv, cache=cache)
 
     mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
-    gate = jax.nn.silu(quant.qdot(mlp_in, layer_params['w_gate']))
+    gate = _mlp_act(cfg)(quant.qdot(mlp_in, layer_params['w_gate']))
     up = quant.qdot(mlp_in, layer_params['w_up'])
     x = x + quant.qdot(gate * up, layer_params['w_down'])
     x = _shard(x, ACT_SPEC)
@@ -419,7 +465,7 @@ def forward(params: Params, tokens: jax.Array,
     if positions is None:
         positions = jnp.arange(s)
     angles = rope_frequencies(cfg, positions)
-    x = quant.qtake(params['embed'], tokens, cfg.dtype)
+    x = _embed(params, tokens, cfg)
     x = _shard(x, ACT_SPEC)
 
     # Bind return_kv BEFORE any jax.checkpoint wrap: a bool passed through
@@ -573,7 +619,7 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
     angles = jax.vmap(
         lambda p: rope_frequencies(cfg, p[None]))(lengths)    # [B,1,half]
 
-    x = quant.qtake(params['embed'], tokens, cfg.dtype)[:, None]  # [B,1,D]
+    x = _embed(params, tokens, cfg)[:, None]              # [B,1,D]
     rows = jnp.arange(tokens.shape[0])
 
     def shard_layer_slice(leaf):

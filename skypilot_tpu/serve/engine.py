@@ -179,6 +179,13 @@ class Engine:
             # once (quantize passes norm/router leaves through, and any
             # numpy leaf would be re-transferred on every dispatch).
             params = jax.device_put(params)
+        if isinstance(params, dict) and getattr(
+                model_cfg, 'tied_embeddings', False):
+            # ONE device copy of the tied [V, D] matrix (a 256k-vocab
+            # Gemma otherwise holds ~1.6 GB of duplicate HBM; the
+            # transient duplicate from the device_put above is freed
+            # here).
+            params = {**params, 'lm_head': params['embed']}
         self.params = params
         self._cache = cache
         self._lengths = jnp.zeros((b,), jnp.int32)

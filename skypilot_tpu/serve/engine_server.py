@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import jax
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.models import gemma
 from skypilot_tpu.models import llama
 from skypilot_tpu.models import mixtral
 from skypilot_tpu.serve import engine as engine_lib
@@ -70,6 +71,10 @@ MODEL_PRESETS = {
     'tiny': (llama.llama_tiny, llama),
     'llama3-1b': (llama.llama3_1b, llama),
     'llama3-8b': (llama.llama3_8b, llama),
+    'qwen2-7b': (llama.qwen2_7b, llama),
+    'gemma-2b': (gemma.gemma_2b, llama),
+    'gemma-7b': (gemma.gemma_7b, llama),
+    'gemma-tiny': (gemma.gemma_tiny, llama),
     'mixtral-tiny': (mixtral.mixtral_tiny, mixtral),
     'mixtral-8x7b': (mixtral.mixtral_8x7b, mixtral),
 }
