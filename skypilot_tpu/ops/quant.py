@@ -70,9 +70,32 @@ def dequantize(w: QTensor, reduce_axes=(-2,),
     return (w.q.astype(jnp.float32) * scale).astype(dtype)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LoraWeight:
+    """Low-rank-adapted weight: base (dense or QTensor — QLoRA) plus
+    trainable A [D, r] / B [r, F] with the static alpha/r scale.
+    qdot computes x@W + ((x@A)@B)*scale — the factored form, never
+    materializing the rank-r update as a full matrix."""
+    base: Any             # [D, F] dense array or QTensor
+    a: jax.Array          # [D, r]
+    b: jax.Array          # [r, F]
+    scale: float          # alpha / r (static: aux_data, not a leaf)
+
+    def tree_flatten(self):
+        return (self.base, self.a, self.b), self.scale
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux)
+
+
 def qdot(x: jax.Array, w: Any) -> jax.Array:
-    """x [..., D] @ w [D, F] where w is dense or a QTensor with
-    per-[F] scale."""
+    """x [..., D] @ w [D, F] where w is dense, a QTensor with per-[F]
+    scale, or a LoraWeight over either."""
+    if isinstance(w, LoraWeight):
+        delta = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
+        return qdot(x, w.base) + delta * w.scale
     if isinstance(w, QTensor):
         return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
     return x @ w

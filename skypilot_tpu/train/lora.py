@@ -1,0 +1,203 @@
+"""LoRA finetuning, TPU-first (reference capability:
+llm/llama-3_1-finetuning/lora.yaml — the reference shells out to
+torchtune; here the adapters train in-framework on the same functional
+models that serve).
+
+Design:
+  * adapters are their OWN pytree ({layer_key: {'a': [L, D, r],
+    'b': [L, r, F]}}); the base model is a frozen INPUT to the train
+    step (not a closure constant — XLA would bake gigabytes of weights
+    into the executable), so optimizer state exists only for the
+    adapters: finetuning an 8B model carries ~millions, not billions,
+    of Adam moments.
+  * `apply()` grafts ops/quant.LoraWeight leaves onto the param tree;
+    every projection already routes through quant.qdot, which computes
+    the factored x@W + ((x@A)@B)*alpha/r — no materialized deltas, and
+    the base may be int8 (QLoRA) since qdot recurses.
+  * `merge()` folds trained adapters into plain dense weights for
+    serving/export — the merged tree is a normal checkpoint.
+  * B initializes to zero (step-0 model == base model, the standard
+    LoRA init); A is scaled-normal.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import quant
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import trainer
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # Layer-stack weight keys to adapt (classic attention-only default;
+    # add w_gate/w_up/w_down for full-MLP LoRA).
+    target_keys: Tuple[str, ...] = ('wq', 'wv')
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_shapes(cfg: llama.LlamaConfig) -> Dict[str, Tuple[int, int]]:
+    d, f = cfg.dim, cfg.ffn_dim
+    qd = cfg.n_heads * cfg.head_dim
+    kvd = cfg.n_kv_heads * cfg.head_dim
+    return {'wq': (d, qd), 'wk': (d, kvd), 'wv': (d, kvd),
+            'wo': (qd, d), 'w_gate': (d, f), 'w_up': (d, f),
+            'w_down': (f, d)}
+
+
+def init_adapters(key: jax.Array, cfg: llama.LlamaConfig,
+                  lora_cfg: LoraConfig) -> Dict[str, Any]:
+    shapes = _target_shapes(cfg)
+    out: Dict[str, Any] = {}
+    for name in lora_cfg.target_keys:
+        din, dout = shapes[name]
+        key, sub = jax.random.split(key)
+        out[name] = {
+            'a': (jax.random.normal(sub, (cfg.n_layers, din,
+                                          lora_cfg.rank), jnp.float32)
+                  / jnp.sqrt(din)).astype(cfg.dtype),
+            'b': jnp.zeros((cfg.n_layers, lora_cfg.rank, dout),
+                           cfg.dtype),
+        }
+    return out
+
+
+def adapter_shardings(cfg: llama.LlamaConfig, lora_cfg: LoraConfig,
+                      model: Any = llama) -> Dict[str, Any]:
+    """A inherits the base weight's input-axis sharding, B its
+    output-axis sharding; the rank axis is replicated (it is tiny)."""
+    weight_specs = model.param_shardings(cfg)['layers']
+    out: Dict[str, Any] = {}
+    for name in lora_cfg.target_keys:
+        spec = weight_specs.get(name)
+        if spec is None or len(spec) != 3:
+            # 4-axis specs are MoE expert stacks [L, E, D, F]: per-
+            # expert LoRA is not implemented — adapt attention keys.
+            raise NotImplementedError(
+                f'LoRA target {name!r} is not a [L, D, F] weight of '
+                f'this model (adapt attention keys for MoE models)')
+        _l, in_spec, out_spec = spec
+        out[name] = {'a': P(None, in_spec, None),
+                     'b': P(None, None, out_spec)}
+    return out
+
+
+def apply(params: llama.Params, adapters: Dict[str, Any],
+          lora_cfg: LoraConfig) -> llama.Params:
+    """Param tree with LoraWeight leaves on the adapted keys — feed to
+    any forward/decode path (they all project through quant.qdot)."""
+    layers = dict(params['layers'])
+    for name, ab in adapters.items():
+        layers[name] = quant.LoraWeight(base=layers[name], a=ab['a'],
+                                        b=ab['b'],
+                                        scale=lora_cfg.scale)
+    return {**params, 'layers': layers}
+
+
+def merge(params: llama.Params, adapters: Dict[str, Any],
+          lora_cfg: LoraConfig) -> llama.Params:
+    """Fold adapters into plain dense weights (serving/export). The
+    base must be dense (merge an int8 base by dequantizing first)."""
+    layers = dict(params['layers'])
+    for name, ab in adapters.items():
+        base = layers[name]
+        if isinstance(base, quant.QTensor):
+            raise ValueError(
+                'merge() needs a dense base; dequantize the int8 base '
+                'first (QLoRA bases are usually served unmerged via '
+                'apply()).')
+        delta = jnp.einsum('ldr,lrf->ldf',
+                           ab['a'].astype(jnp.float32),
+                           ab['b'].astype(jnp.float32))
+        layers[name] = (base.astype(jnp.float32)
+                        + delta * lora_cfg.scale).astype(base.dtype)
+    return {**params, 'layers': layers}
+
+
+def init_adapter_state(cfg: llama.LlamaConfig, mesh, lora_cfg: LoraConfig,
+                       optimizer: optax.GradientTransformation,
+                       seed: int = 0, model: Any = llama):
+    """(TrainState over adapters, state shardings) — the trainable half
+    of a LoRA run; the frozen base rides separately."""
+    specs = adapter_shardings(cfg, lora_cfg, model=model)
+    to_ns = lambda s: NamedSharding(mesh, s)   # noqa: E731
+    adapter_ns = jax.tree.map(to_ns, specs)
+
+    def _init(key):
+        adapters = init_adapters(key, cfg, lora_cfg)
+        return trainer.TrainState(step=jnp.zeros((), jnp.int32),
+                                  params=adapters,
+                                  opt_state=optimizer.init(adapters))
+
+    adapters_struct = jax.eval_shape(
+        lambda k: init_adapters(k, cfg, lora_cfg), jax.random.PRNGKey(0))
+    opt_struct = jax.eval_shape(optimizer.init, adapters_struct)
+    # Adam moments mirror the adapter tree: path-suffix spec match
+    # (shape matching collides — wq.a and wo.a are identically shaped
+    # but transposed-sharded whenever n_heads*head_dim == dim).
+    opt_ns = trainer.opt_state_shardings(mesh, specs, opt_struct)
+    state_shardings = trainer.TrainState(step=to_ns(P()),
+                                         params=adapter_ns,
+                                         opt_state=opt_ns)
+    state = jax.jit(_init, out_shardings=state_shardings)(
+        jax.random.PRNGKey(seed))
+    return state, state_shardings
+
+
+def make_lora_train_step(cfg: llama.LlamaConfig, mesh,
+                         optimizer: optax.GradientTransformation,
+                         state_shardings, lora_cfg: LoraConfig,
+                         model: Any = llama):
+    """Jitted SPMD step: gradients and optimizer updates over ADAPTERS
+    only; the frozen base params are a sharded input (donated? no —
+    reused every step)."""
+    base_ns = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                           model.param_shardings(cfg))
+    batch_sharding = NamedSharding(mesh, P(('dp', 'fsdp'), None))
+
+    if hasattr(model, 'make_loss_fn'):
+        # Models with auxiliary losses (mixtral's router terms).
+        base_loss = model.make_loss_fn(cfg)
+
+        def loss_fn(adapters, base, tokens):
+            return base_loss(apply(base, adapters, lora_cfg), tokens)
+    else:
+        def loss_fn(adapters, base, tokens):
+            params = apply(base, adapters, lora_cfg)
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            logits = model.forward(params, inputs, cfg)
+            return trainer.cross_entropy_loss(logits, targets)
+
+    def step_fn(state, base, batch):
+        with mesh_lib.use_mesh(mesh):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, base, batch['tokens'])
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_adapters = optax.apply_updates(state.params, updates)
+        metrics = {'loss': loss,
+                   'grad_norm': optax.global_norm(grads),
+                   'step': state.step + 1}
+        return trainer.TrainState(step=state.step + 1,
+                                  params=new_adapters,
+                                  opt_state=new_opt), metrics
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, base_ns,
+                      {'tokens': batch_sharding}),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,))

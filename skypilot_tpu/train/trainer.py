@@ -82,6 +82,19 @@ def state_shardings(cfg: Any, mesh: Mesh,
     (models/llama.py, models/mixtral.py, ...)."""
     del params_struct
     pspecs = model.param_shardings(cfg)
+    return TrainState(
+        step=NamedSharding(mesh, P()),
+        params=jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs),
+        opt_state=opt_state_shardings(mesh, pspecs, opt_state_struct))
+
+
+def opt_state_shardings(mesh: Mesh, pspecs: Any,
+                        opt_state_struct: Any) -> Any:
+    """Shard optimizer-state leaves by PATH-SUFFIX match against the
+    param spec tree (mu/nu are structural copies of the params) — not
+    by shape, which collides for identically-shaped but
+    transposed-sharded weights (wq vs wo). Scalars replicate. Shared
+    by the full trainer and the LoRA adapter trainer."""
 
     def _path_key(path) -> tuple:
         out = []
@@ -104,11 +117,8 @@ def state_shardings(cfg: Any, mesh: Mesh,
                 return NamedSharding(mesh, spec)
         return NamedSharding(mesh, P())
 
-    return TrainState(
-        step=NamedSharding(mesh, P()),
-        params=jax.tree.map(lambda s: NamedSharding(mesh, s), pspecs),
-        opt_state=jax.tree_util.tree_map_with_path(opt_leaf_sharding,
-                                                   opt_state_struct))
+    return jax.tree_util.tree_map_with_path(opt_leaf_sharding,
+                                            opt_state_struct)
 
 
 def init_train_state(cfg: Any, mesh: Mesh,

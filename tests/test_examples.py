@@ -32,6 +32,8 @@ def test_example_yaml_parses(path):
                       '--batch-size', '8', '--seq-len', '128']),
     ('train_resnet.py', ['--arch', 'tiny', '--steps', '2',
                          '--batch-size', '16', '--image-size', '32']),
+    ('finetune_lora.py', ['--model', 'llama-tiny', '--steps', '2',
+                          '--batch-size', '8', '--seq-len', '64']),
 ])
 def test_example_script_runs(script, args):
     env = dict(os.environ,
