@@ -85,6 +85,15 @@ def test_pipeline_task2_failure_fails_job():
     assert _wait(job_id, {'SUCCEEDED', 'FAILED'}) == 'FAILED'
     # Task 1 ran exactly once; its cluster was cleaned up before task 2.
     assert len(open(marker).read().splitlines()) == 1
+    # The controller flips FAILED before its cleanup down() finishes —
+    # poll rather than assert instantly (status order is product
+    # behavior; the invariant is that cleanup HAPPENS).
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(global_user_state.get_cluster(
+                f'skyt-jobs-{job_id}-{idx}') is None for idx in (0, 1)):
+            break
+        time.sleep(0.3)
     for idx in (0, 1):
         assert global_user_state.get_cluster(
             f'skyt-jobs-{job_id}-{idx}') is None
