@@ -342,6 +342,30 @@ def _launch_to_first_step(first_step_s=None):
     return report
 
 
+def _tpu_probe(timeout_s: float = 150.0):
+    """Probe the TPU in a SUBPROCESS: a dead axon tunnel HANGS at
+    backend init (it does not error), which would stall the entire
+    bench run. The probe both initializes the backend and runs one op
+    with a host read-back. Returns None when healthy, else a reason
+    string distinguishing a hang from a clean no-TPU/init failure."""
+    import subprocess
+    import sys
+    code = ('import jax, jax.numpy as jnp\n'
+            "assert jax.devices()[0].platform != 'cpu', 'no TPU platform'\n"
+            'x = jnp.ones((128, 128), jnp.bfloat16)\n'
+            'assert float((x @ x).sum()) > 0\n')
+    try:
+        proc = subprocess.run([sys.executable, '-c', code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return f'TPU backend hung at init (> {timeout_s:.0f}s)'
+    if proc.returncode == 0:
+        return None
+    tail = (proc.stderr or '').strip()[-300:]
+    return f'TPU probe failed: {tail or "no TPU platform registered"}'
+
+
 def main() -> None:
     import os
 
@@ -351,8 +375,15 @@ def main() -> None:
     # Honor JAX_PLATFORMS=cpu even under the axon TPU tunnel, whose
     # plugin self-registers regardless of the env var (same pin as
     # tests/conftest.py) — a CPU bench run must not touch the tunnel.
+    # A dead/hung tunnel likewise degrades to CPU numbers (with a
+    # marker in the output) instead of hanging the bench forever.
+    tpu_unavailable = None
     if os.environ.get('JAX_PLATFORMS') == 'cpu':
         jax.config.update('jax_platforms', 'cpu')
+    else:
+        tpu_unavailable = _tpu_probe()
+        if tpu_unavailable is not None:
+            jax.config.update('jax_platforms', 'cpu')
 
     device = jax.devices()[0]
     on_tpu = device.platform != 'cpu'
@@ -382,7 +413,7 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — optional metric
         launch_report = {'error': str(e)[:200]}
 
-    print(json.dumps({
+    out = {
         'metric': 'llama_train_mfu_single_chip',
         'value': round(mfu_pct, 2),
         'unit': '% of peak bf16 FLOPs '
@@ -392,7 +423,11 @@ def main() -> None:
         'flagship': flagship_report,
         'serving': serving_report,
         'launch': launch_report,
-    }))
+    }
+    if tpu_unavailable:
+        out['tpu_unavailable'] = (
+            f'{tpu_unavailable}; CPU fallback numbers')
+    print(json.dumps(out))
 
 
 if __name__ == '__main__':
