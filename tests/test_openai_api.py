@@ -270,3 +270,29 @@ def test_stream_decoder_multibyte():
     outs = [dec.push(t) for t in ids]
     assert ''.join(outs) == '❤'
     assert outs[0] == '' and outs[1] == ''
+
+
+def test_chat_template_used_when_checkpoint_ships_one(tmp_path):
+    """A checkpoint with a jinja chat_template must be rendered through
+    it (not the generic 'role: content' transcript)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token='<unk>'))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.train_from_iterator(
+        ['hello world user assistant chat BEGIN END'] * 8,
+        trainers.BpeTrainer(vocab_size=120,
+                            special_tokens=['<unk>', '<s>', '</s>']))
+    tok.save(str(tmp_path / 'tokenizer.json'))
+    (tmp_path / 'tokenizer_config.json').write_text(json.dumps({
+        'tokenizer_class': 'PreTrainedTokenizerFast',
+        'eos_token': '</s>', 'unk_token': '<unk>',
+        'chat_template':
+            "{% for m in messages %}BEGIN {{ m['content'] }} END "
+            "{% endfor %}{% if add_generation_prompt %}assistant"
+            "{% endif %}"}))
+    t = tokenizer_lib.HFTokenizer(str(tmp_path))
+    ids = t.apply_chat_template([{'role': 'user', 'content': 'hello'}])
+    rendered = t.decode(ids)
+    assert 'BEGIN' in rendered and 'END' in rendered, rendered
+    # Generic fallback is NOT what produced this (no 'user:' prefix).
+    assert 'user :' not in rendered and 'user:' not in rendered
