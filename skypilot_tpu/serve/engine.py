@@ -100,6 +100,7 @@ class _Slot:
     tokens: List[int]                 # generated so far
     max_new_tokens: int
     out_queue: Optional[Any] = None   # streaming sink (queue.Queue)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
 
 
 class Engine:
@@ -217,11 +218,11 @@ class Engine:
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
             static_argnames=('sampling_on',),
-            out_shardings=out_s(repl, kv_ns))
+            out_shardings=out_s(repl, repl, kv_ns))
         self._prefill_many_jit = jax.jit(
             functools.partial(self._prefill_many_impl, cfg=model_cfg),
             static_argnames=('sampling_on',),
-            out_shardings=out_s(repl, kv_ns))
+            out_shardings=out_s(repl, repl, kv_ns))
         self._insert_jit = jax.jit(
             self._insert_impl, donate_argnums=(0,),
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
@@ -231,11 +232,11 @@ class Engine:
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
             static_argnames=('sampling_on',), donate_argnums=(1,),
-            out_shardings=out_s(repl, cache_ns, repl))
+            out_shardings=out_s(repl, repl, cache_ns, repl))
         self._decode_many_jit = jax.jit(
             functools.partial(self._decode_many_impl, cfg=model_cfg),
             static_argnames=('k', 'sampling_on'), donate_argnums=(1,),
-            out_shardings=out_s(repl, cache_ns, repl, repl))
+            out_shardings=out_s(repl, repl, cache_ns, repl, repl))
 
     # -- device programs ------------------------------------------------ #
 
@@ -258,9 +259,12 @@ class Engine:
 
     def _sample(self, logits: jax.Array, key: jax.Array,
                 temps: jax.Array, topks: jax.Array, topps: jax.Array,
-                sampling_on: bool) -> jax.Array:
+                sampling_on: bool):
         """Batched per-row sampling: logits [B, V], per-row temperature
-        (<=0 greedy), top-k (<=0 off) and top-p (>=1 off).
+        (<=0 greedy), top-k (<=0 off) and top-p (>=1 off). Returns
+        (tokens [B], logprobs [B]) — the chosen token's UNSCALED
+        log-softmax (the model probability, OpenAI `logprobs`
+        convention), one fused vocab reduction on top of the argmax.
 
         `sampling_on` is STATIC (host-tracked: engine slot bookkeeping
         knows whether any live request samples): all-greedy batches —
@@ -268,9 +272,15 @@ class Engine:
         program with no vocab-wide top_k/categorical at all; at most
         two executables exist per step shape."""
         logits = logits.astype(jnp.float32)
+        lse_raw = jax.nn.logsumexp(logits, axis=-1)              # [B]
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def logprob_of(tok):
+            return (jnp.take_along_axis(logits, tok[:, None],
+                                        axis=-1)[:, 0] - lse_raw)
+
         if not sampling_on:
-            return greedy
+            return greedy, logprob_of(greedy)
 
         safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
         scaled = logits / safe_t
@@ -298,7 +308,8 @@ class Engine:
                           -jnp.inf, scaled)
         s = jax.random.categorical(key, final,
                                    axis=-1).astype(jnp.int32)
-        return jnp.where(temps <= 0, greedy, s)
+        chosen = jnp.where(temps <= 0, greedy, s)
+        return chosen, logprob_of(chosen)
 
     def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
                       topp, cfg, sampling_on):
@@ -306,9 +317,9 @@ class Engine:
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[0, true_len - 1]
-        tok = self._sample(last[None], key, temp[None], topk[None],
-                           topp[None], sampling_on)[0]
-        return tok, kv
+        toks, logps = self._sample(last[None], key, temp[None],
+                                   topk[None], topp[None], sampling_on)
+        return toks[0], logps[0], kv
 
     @staticmethod
     def _write_prefix_rows(cache_leaf, prefix_dense, slots, s):
@@ -349,9 +360,9 @@ class Engine:
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
-        toks = self._sample(last, key, temps, topks, topps,
-                            sampling_on)
-        return toks, kv
+        toks, logps = self._sample(last, key, temps, topks, topps,
+                                   sampling_on)
+        return toks, logps, kv
 
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
                           lengths, tokens, first_tokens, temps, topks,
@@ -374,9 +385,9 @@ class Engine:
                      topks, topps, cfg, sampling_on):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-        next_tokens = self._sample(logits, key, temps, topks, topps,
-                                   sampling_on)
-        return next_tokens, new_cache, lengths + 1
+        next_tokens, logps = self._sample(logits, key, temps, topks,
+                                          topps, sampling_on)
+        return next_tokens, logps, new_cache, lengths + 1
 
     def _decode_many_impl(self, params, cache, lengths, tokens, key,
                           temps, topks, topps, k, cfg, sampling_on):
@@ -386,14 +397,14 @@ class Engine:
             cache, lengths, tokens = carry
             logits, cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-            nt = self._sample(logits, subkey, temps, topks, topps,
-                              sampling_on)
-            return (cache, lengths + 1, nt), nt
+            nt, lp = self._sample(logits, subkey, temps, topks, topps,
+                                  sampling_on)
+            return (cache, lengths + 1, nt), (nt, lp)
 
         keys = jax.random.split(key, k)
-        (cache, lengths, tokens), toks = jax.lax.scan(
+        (cache, lengths, tokens), (toks, logps) = jax.lax.scan(
             body, (cache, lengths, tokens), keys)
-        return toks, cache, lengths, tokens
+        return toks, logps, cache, lengths, tokens
 
     # -- host-side API --------------------------------------------------- #
 
@@ -433,19 +444,19 @@ class Engine:
 
     def prefill(self, prompt: Sequence[int],
                 sampling: Optional[SamplingParams] = None
-                ) -> Tuple[int, Any]:
-        """Returns (first generated token, prefix kv) for one prompt."""
+                ) -> Tuple[int, float, Any]:
+        """Returns (first generated token, its logprob, prefix kv)."""
         self._validate(prompt)
         sp = self._sampling_or_default(sampling)
         bucket = self._bucket(len(prompt))
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(prompt)] = prompt
         self._key, sub = jax.random.split(self._key)
-        tok, kv = self._prefill_jit(
+        tok, logp, kv = self._prefill_jit(
             self.params, jnp.asarray(padded), len(prompt), sub,
             jnp.float32(sp.temperature), jnp.int32(sp.top_k),
             jnp.float32(sp.top_p), sampling_on=sp.temperature > 0)
-        return int(tok), kv
+        return int(tok), float(logp), kv
 
     def insert(self, prefix_kv: Any, slot: int, length: int,
                first_token: int,
@@ -466,7 +477,8 @@ class Engine:
 
     def admit(self, assignments: Sequence[Tuple]) -> Dict[int, int]:
         """Prefill + insert a wave of (slot_id, prompt) or (slot_id,
-        prompt, SamplingParams) tuples; returns {slot_id: first_token}.
+        prompt, SamplingParams) tuples; returns {slot_id:
+        (first_token, its logprob)}.
         Same-bucket prompts are grouped into power-of-two batched
         prefills — one forward + one cache scatter per group instead of
         two dispatches per prompt, which is what dominates wall-clock
@@ -496,10 +508,10 @@ class Engine:
                 i += n
                 if n == 1:
                     slot_id, prompt, sp = chunk[0]
-                    first, kv = self.prefill(prompt, sampling=sp)
+                    first, logp, kv = self.prefill(prompt, sampling=sp)
                     self.insert(kv, slot_id, len(prompt), first,
                                 sampling=sp)
-                    out[slot_id] = first
+                    out[slot_id] = (first, logp)
                     continue
                 padded = np.zeros((n, bucket), np.int32)
                 for j, (_sid, p, _sp) in enumerate(chunk):
@@ -515,7 +527,7 @@ class Engine:
                 topps = jnp.asarray([sp.top_p for _s, _p, sp in chunk],
                                     jnp.float32)
                 self._key, sub = jax.random.split(self._key)
-                toks, kv = self._prefill_many_jit(
+                toks, logps, kv = self._prefill_many_jit(
                     self.params, jnp.asarray(padded),
                     jnp.asarray(true_lens), sub, temps, topks, topps,
                     sampling_on=any(sp.temperature > 0
@@ -529,54 +541,63 @@ class Engine:
                     self._topps, temps, topks, topps)
                 # Defer the device->host read: dispatching the next
                 # chunk must not wait on this one retiring.
-                pending_gets.append((chunk, toks))
-        for chunk, toks in pending_gets:
+                pending_gets.append((chunk, toks, logps))
+        for chunk, toks, logps in pending_gets:
             toks_np = np.asarray(jax.device_get(toks))
+            logps_np = np.asarray(jax.device_get(logps))
             for j, (sid, _p, _sp) in enumerate(chunk):
-                out[sid] = int(toks_np[j])
+                out[sid] = (int(toks_np[j]), float(logps_np[j]))
         return out
 
-    def decode(self) -> np.ndarray:
-        """One decode step for every slot; returns the [B] token vector."""
+    def decode(self):
+        """One decode step for every slot; returns ([B] tokens,
+        [B] logprobs)."""
         self._key, sub = jax.random.split(self._key)
-        next_tokens, self._cache, self._lengths = self._decode_jit(
+        next_tokens, logps, self._cache, self._lengths = self._decode_jit(
             self.params, self._cache, self._lengths, self._tokens, sub,
             self._temps, self._topks, self._topps,
             sampling_on=bool((self._host_temps > 0).any()))
         self._tokens = next_tokens
         self._step_count += 1
-        return np.asarray(jax.device_get(next_tokens))
+        toks_np, logps_np = jax.device_get((next_tokens, logps))
+        return np.asarray(toks_np), np.asarray(logps_np)
 
-    def decode_many(self, k: int) -> np.ndarray:
-        """k fused decode steps; returns [k, B] tokens (one dispatch)."""
+    def decode_many(self, k: int):
+        """k fused decode steps; returns ([k, B] tokens, [k, B]
+        logprobs) from one dispatch."""
         if k <= 1:
-            return self.decode()[None, :]
+            toks, logps = self.decode()
+            return toks[None, :], logps[None, :]
         self._key, sub = jax.random.split(self._key)
-        toks, self._cache, self._lengths, self._tokens = \
+        toks, logps, self._cache, self._lengths, self._tokens = \
             self._decode_many_jit(self.params, self._cache, self._lengths,
                                   self._tokens, sub, self._temps,
                                   self._topks, self._topps, k=k,
                                   sampling_on=bool(
                                       (self._host_temps > 0).any()))
         self._step_count += k
-        return np.asarray(jax.device_get(toks))
+        toks_np, logps_np = jax.device_get((toks, logps))
+        return np.asarray(toks_np), np.asarray(logps_np)
 
     # -- continuous batching --------------------------------------------- #
 
     def generate_batch(self, prompts: Sequence[Sequence[int]],
                        max_new_tokens: int = 32,
-                       sampling: Any = None) -> List[List[int]]:
+                       sampling: Any = None,
+                       return_logprobs: bool = False):
         """Offline API: all prompts through the continuous-batching loop;
         slots are refilled as requests finish (no drain barrier).
         `sampling`: None (engine default), one SamplingParams for all
-        prompts, or a per-prompt sequence."""
+        prompts, or a per-prompt sequence. With return_logprobs, returns
+        (token lists, per-token logprob lists)."""
         if sampling is None or isinstance(sampling, SamplingParams):
             per_prompt = [sampling] * len(prompts)
         else:
             if len(sampling) != len(prompts):
                 raise ValueError('sampling list length != prompts')
             per_prompt = list(sampling)
-        results: Dict[int, List[int]] = {}
+        # request_id -> (token list, per-token logprob list)
+        results: Dict[int, Tuple[List[int], List[float]]] = {}
         pending = list(enumerate(prompts))[::-1]   # pop() takes req 0 first
         slots: Dict[int, _Slot] = {}
 
@@ -593,9 +614,10 @@ class Engine:
             if wave:
                 firsts = self.admit(wave)
                 for slot_id, prompt, _sp in wave:
+                    first, logp = firsts[slot_id]
                     slots[slot_id] = _Slot(meta[slot_id], len(prompt),
-                                           [firsts[slot_id]],
-                                           max_new_tokens)
+                                           [first], max_new_tokens,
+                                           logprobs=[logp])
                     self._finish_if_done(slots, slot_id, results)
             if not slots:
                 continue
@@ -615,13 +637,19 @@ class Engine:
                 for slot in slots.values())
             k = (self.cfg.decode_chunk
                  if headroom >= self.cfg.decode_chunk else 1)
-            chunk = self.decode_many(k)
+            chunk, chunk_logps = self.decode_many(k)
             for step in range(k):
                 for slot_id in list(slots):
                     slot = slots[slot_id]
                     slot.tokens.append(int(chunk[step, slot_id]))
+                    slot.logprobs.append(
+                        float(chunk_logps[step, slot_id]))
                     self._finish_if_done(slots, slot_id, results)
-        return [results[i] for i in range(len(prompts))]
+        ordered = [results[i] for i in range(len(prompts))]
+        if return_logprobs:
+            return ([t for t, _lp in ordered],
+                    [lp for _t, lp in ordered])
+        return [t for t, _lp in ordered]
 
     def _is_eos(self, tok: int) -> bool:
         eos = self.cfg.eos_id
@@ -630,7 +658,9 @@ class Engine:
         return eos >= 0 and tok == eos
 
     def _finish_if_done(self, slots: Dict[int, _Slot], slot_id: int,
-                        results: Optional[Dict[int, List[int]]]) -> None:
+                        results: Optional[Dict[int, Tuple[List[int],
+                                                          List[float]]]]
+                        ) -> None:
         slot = slots[slot_id]
         done = (len(slot.tokens) >= slot.max_new_tokens
                 or self._is_eos(slot.tokens[-1])
@@ -638,10 +668,12 @@ class Engine:
                 >= self.cfg.max_decode_len - 1)
         if done:
             out = slot.tokens
+            logps = slot.logprobs[:len(slot.tokens)]
             if out and self._is_eos(out[-1]):
                 out = out[:-1]
+                logps = logps[:len(out)]
             if results is not None:
-                results[slot.request_id] = out
+                results[slot.request_id] = (out, logps)
             if slot.out_queue is not None:
                 slot.out_queue.put(None)        # end-of-stream
             del slots[slot_id]
@@ -655,10 +687,10 @@ class Engine:
     def run_loop(self, request_queue: 'queue.Queue',
                  stop: threading.Event) -> None:
         """Continuous loop: pull (prompt, max_new, out_queue) requests,
-        stream generated tokens into out_queue (an Exception then None on
-        invalid input; None terminates the stream), refill slots as they
-        free up in strict arrival order. Idles (blocking get) when no
-        request is in flight."""
+        stream (token, logprob) pairs into out_queue (an Exception then
+        None on invalid input; None terminates the stream), refill
+        slots as they free up in strict arrival order. Idles (blocking
+        get) when no request is in flight."""
         slots: Dict[int, _Slot] = {}
         waiting: collections.deque = collections.deque()
         next_id = 0
@@ -718,22 +750,24 @@ class Engine:
                             out_q.put(None)
                     continue
                 for slot_id, prompt, _sp in wave:
-                    first = firsts[slot_id]
+                    first, first_logp = firsts[slot_id]
                     max_new, out_q = meta[slot_id]
                     slots[slot_id] = _Slot(next_id, len(prompt), [first],
-                                           max_new, out_q)
+                                           max_new, out_q,
+                                           logprobs=[first_logp])
                     next_id += 1
                     if out_q is not None and not self._is_eos(first):
-                        out_q.put(first)
+                        out_q.put((first, first_logp))
                     self._finish_if_done(slots, slot_id, None)
             if not slots:
                 continue
-            tokens = self.decode()
+            tokens, logps = self.decode()
             for slot_id in list(slots):
                 slot = slots[slot_id]
                 tok = int(tokens[slot_id])
                 slot.tokens.append(tok)
+                slot.logprobs.append(float(logps[slot_id]))
                 if not self._is_eos(tok):
                     if slot.out_queue is not None:
-                        slot.out_queue.put(tok)
+                        slot.out_queue.put((tok, float(logps[slot_id])))
                 self._finish_if_done(slots, slot_id, None)

@@ -171,7 +171,7 @@ class ModelServer:
         self._httpd = None
 
     def _warmup(self) -> None:
-        first, kv = self.engine.prefill([BOS_ID])
+        first, _logp, kv = self.engine.prefill([BOS_ID])
         self.engine.insert(kv, 0, 1, first)
         self.engine.decode()
         # Reset state after warm-up compile.
@@ -287,9 +287,10 @@ class ModelServer:
                     (tokens, max_new, out_q, sampling))
                 return out_q
 
-            def _collect(self, out_q: 'queue.Queue'
-                         ) -> Tuple[List[int], Optional[Exception]]:
+            def _collect(self, out_q: 'queue.Queue'):
+                """Drain a stream queue of (token, logprob) pairs."""
                 toks: List[int] = []
+                logps: List[float] = []
                 error = None
                 while True:
                     item = out_q.get()
@@ -298,8 +299,10 @@ class ModelServer:
                     if isinstance(item, Exception):
                         error = item
                         continue
-                    toks.append(item)
-                return toks, error
+                    tok, logp = item
+                    toks.append(tok)
+                    logps.append(logp)
+                return toks, logps, error
 
             # -- /generate (legacy ids+text API) ---------------------- #
 
@@ -319,11 +322,13 @@ class ModelServer:
                         out_q,
                         lambda tok, delta: {'token': tok, 'text': delta})
                     return
-                toks, error = self._collect(out_q)
+                toks, logps, error = self._collect(out_q)
                 if error is not None:
                     self._error(400, str(error))
                     return
                 self._json(200, {'tokens': toks,
+                                 'logprobs': [round(p, 6)
+                                              for p in logps],
                                  'text': server._decode_text(toks)})
 
             # -- OpenAI-compatible endpoints -------------------------- #
@@ -369,6 +374,14 @@ class ModelServer:
                 # can only surface as an in-band error frame, which a
                 # client sees as a 200.
                 server.engine._validate(tokens)
+                want_logprobs = req.get('logprobs')
+                if want_logprobs is not None and not isinstance(
+                        want_logprobs, (bool, int)):
+                    raise _BadRequest('logprobs must be a bool/int')
+                if want_logprobs and bool(req.get('stream', False)):
+                    raise _BadRequest(
+                        'logprobs with stream=true is not supported '
+                        '(token->text deltas do not map 1:1)')
                 rid = (f'chatcmpl-{int(time.time()*1000)}' if chat
                        else f'cmpl-{int(time.time()*1000)}')
                 created = int(time.time())
@@ -377,7 +390,7 @@ class ModelServer:
                     self._stream_openai(out_q, rid, created, chat, stop,
                                         max_new)
                     return
-                toks, error = self._collect(out_q)
+                toks, logps, error = self._collect(out_q)
                 if error is not None:
                     self._error(400, str(error))
                     return
@@ -387,15 +400,53 @@ class ModelServer:
                 if cut >= 0:
                     text = text[:cut]
                     finish = 'stop'
+                logprobs_obj = None
+                if want_logprobs:
+                    # Per-token strings are incremental-decode DIFFS so
+                    # they concatenate exactly to the choice text
+                    # (isolated per-id decode loses BPE word-boundary
+                    # spacing); a stop-sequence cut truncates the token
+                    # list to the kept text the same way.
+                    token_strs: List[str] = []
+                    dec = (tokenizer_lib.StreamDecoder(server.tokenizer)
+                           if server.tokenizer else None)
+                    for t in toks:
+                        token_strs.append(dec.push(t) if dec else '')
+                    if dec is not None and token_strs:
+                        token_strs[-1] += dec.flush()
+                    kept_lps = [round(p, 6) for p in logps]
+                    if cut >= 0:
+                        kept, acc = [], 0
+                        for ts in token_strs:
+                            if acc >= len(text):
+                                break
+                            kept.append(ts[:len(text) - acc])
+                            acc += len(ts)
+                        token_strs = kept
+                        kept_lps = kept_lps[:len(kept)]
+                    if chat:
+                        # chat.completion logprobs schema.
+                        logprobs_obj = {'content': [
+                            {'token': ts, 'logprob': p}
+                            for ts, p in zip(token_strs, kept_lps)]}
+                    else:
+                        # Legacy text-completion logprobs schema.
+                        logprobs_obj = {
+                            'tokens': token_strs,
+                            'token_logprobs': kept_lps,
+                            'top_logprobs': None,
+                        }
                 if chat:
                     choice = {'index': 0,
                               'message': {'role': 'assistant',
                                           'content': text},
+                              'logprobs': logprobs_obj,
                               'finish_reason': finish}
                     obj = 'chat.completion'
                 else:
                     choice = {'index': 0, 'text': text,
-                              'logprobs': None, 'finish_reason': finish}
+                              'logprobs': logprobs_obj,
+                              'finish_reason': finish}
                     obj = 'text_completion'
                 self._json(200, {
                     'id': rid, 'object': obj, 'created': created,
@@ -435,8 +486,9 @@ class ModelServer:
                         if isinstance(item, Exception):
                             payload = {'error': str(item)}
                         else:
-                            delta = dec.push(item) if dec else ''
-                            payload = make_payload(item, delta)
+                            tok, _logp = item
+                            delta = dec.push(tok) if dec else ''
+                            payload = make_payload(tok, delta)
                         self._chunk(b'data: '
                                     + json.dumps(payload).encode()
                                     + b'\n\n')
@@ -513,7 +565,8 @@ class ModelServer:
                                 + b'\n\n')
                             continue
                         n_tokens += 1
-                        delta = dec.push(item) if dec else ''
+                        tok, _logp = item
+                        delta = dec.push(tok) if dec else ''
                         if stop:
                             pending += delta
                             cut = _first_stop_match(pending, stop)

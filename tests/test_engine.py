@@ -117,7 +117,9 @@ def test_online_loop_streams_tokens(model):
         item = out_q.get(timeout=30)
         if item is None:
             break
-        toks.append(item)
+        tok, logp = item          # queue streams (token, logprob)
+        assert logp <= 0.0
+        toks.append(tok)
     req_q.put(None)
     t.join(timeout=10)
     assert toks == _ref_greedy(params, cfg, prompt, 5)
@@ -329,7 +331,7 @@ def test_run_loop_survives_malformed_request(model):
         item = good_q.get(timeout=30)
         if item is None:
             break
-        toks.append(item)
+        toks.append(item[0])
     req_q.put(None)
     t.join(timeout=10)
     assert toks == _ref_greedy(params, cfg, [3, 17, 99], 3)
@@ -450,9 +452,9 @@ def test_topp_mass_uses_full_distribution(model):
     logits = jnp.zeros((1, cfg.vocab_size))   # flat: every p = 1/128
     toks = set()
     for i in range(200):
-        t = eng._sample(logits, jax.random.PRNGKey(i),
-                        jnp.asarray([1.0]), jnp.asarray([0]),
-                        jnp.asarray([0.95]), sampling_on=True)
+        t, _lp = eng._sample(logits, jax.random.PRNGKey(i),
+                             jnp.asarray([1.0]), jnp.asarray([0]),
+                             jnp.asarray([0.95]), sampling_on=True)
         toks.add(int(t[0]))
     # True nucleus at p=0.95 over a flat 128-vocab = ~122 tokens; the
     # top-64 candidate cap binds first, so all 64 candidates must be
@@ -474,3 +476,42 @@ def test_sampled_slot_releases_greedy_fast_path(model):
     assert not (eng._host_temps > 0).any()
     eng.generate_batch([[5, 9]], max_new_tokens=3)   # greedy again
     assert not (eng._host_temps > 0).any()
+
+
+# ------------------------------------------------------------------ #
+# Token logprobs (OpenAI `logprobs` support)
+# ------------------------------------------------------------------ #
+
+def test_generate_batch_logprobs_match_forward():
+    """Per-token logprobs from the engine equal the model's own
+    log-softmax at each greedy-chosen token (fp32 model, exact path:
+    prefill first token + cached decode steps)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import llama as llama_lib
+    cfg = llama_lib.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    params = llama_lib.init_params(jax.random.PRNGKey(0), cfg)
+    eng = engine_lib.Engine(
+        cfg, params, engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(8,)))
+    prompt = [3, 17, 99, 42]
+    [toks], [logps] = eng.generate_batch([prompt], max_new_tokens=5,
+                                         return_logprobs=True)
+    assert len(logps) == len(toks)
+    # Reference: run the full forward over prompt+generated and read
+    # the log-softmax at each generated token.
+    seq = prompt + toks
+    logits = np.asarray(llama_lib.forward(
+        params, jnp.asarray([seq], jnp.int32), cfg))[0]
+    logsm = logits - np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+        - logits.max(-1, keepdims=True)
+    for i, (tok, lp) in enumerate(zip(toks, logps)):
+        want = logsm[len(prompt) - 1 + i, tok]
+        assert abs(lp - want) < 5e-3, (i, lp, want)
+        assert lp <= 0.0

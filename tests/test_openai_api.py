@@ -296,3 +296,60 @@ def test_chat_template_used_when_checkpoint_ships_one(tmp_path):
     assert 'BEGIN' in rendered and 'END' in rendered, rendered
     # Generic fallback is NOT what produced this (no 'user:' prefix).
     assert 'user :' not in rendered and 'user:' not in rendered
+
+
+def test_completions_logprobs(server):
+    status, out = _post(server.port, '/v1/completions',
+                        {'prompt': 'hello world', 'max_tokens': 5,
+                         'logprobs': True})
+    assert status == 200
+    lp = out['choices'][0]['logprobs']
+    n = out['usage']['completion_tokens']
+    assert len(lp['token_logprobs']) == n == len(lp['tokens'])
+    assert all(isinstance(p, float) and p <= 0.0
+               for p in lp['token_logprobs'])
+    # The per-token strings concatenate to the choice text.
+    assert ''.join(lp['tokens']) == out['choices'][0]['text']
+
+    # /generate carries raw logprobs alongside token ids.
+    status, gen = _post(server.port, '/generate',
+                        {'prompt': 'hello world', 'max_new_tokens': 5})
+    assert status == 200
+    assert len(gen['logprobs']) == len(gen['tokens'])
+
+
+def test_logprobs_with_stream_rejected(server):
+    status, _ = _post(server.port, '/v1/completions',
+                      {'prompt': 'hello', 'max_tokens': 4,
+                       'logprobs': True, 'stream': True})
+    assert status == 400
+
+
+def test_logprobs_align_with_stop_cut(server):
+    """A stop cut truncates the logprobs token list to the kept text."""
+    status, full = _post(server.port, '/v1/completions',
+                         {'prompt': 'hello world', 'max_tokens': 8,
+                          'logprobs': True})
+    text = full['choices'][0]['text']
+    if len(text.strip()) < 2:
+        pytest.skip('tiny model generated no usable text')
+    stop = text.strip()[-1]
+    status, out = _post(server.port, '/v1/completions',
+                        {'prompt': 'hello world', 'max_tokens': 8,
+                         'logprobs': True, 'stop': stop})
+    assert status == 200
+    lp = out['choices'][0]['logprobs']
+    assert ''.join(lp['tokens']) == out['choices'][0]['text']
+    assert len(lp['token_logprobs']) == len(lp['tokens'])
+
+
+def test_chat_logprobs_schema(server):
+    status, out = _post(
+        server.port, '/v1/chat/completions',
+        {'messages': [{'role': 'user', 'content': 'hello world'}],
+         'max_tokens': 5, 'logprobs': True})
+    assert status == 200
+    content = out['choices'][0]['logprobs']['content']
+    assert all(set(e) == {'token', 'logprob'} for e in content)
+    assert (''.join(e['token'] for e in content)
+            == out['choices'][0]['message']['content'])

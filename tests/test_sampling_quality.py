@@ -63,7 +63,7 @@ def _draw(eng, logits, sp: SamplingParams, n=N_SAMPLES) -> np.ndarray:
     topks = jnp.full((batch,), sp.top_k, jnp.int32)
     topps = jnp.full((batch,), sp.top_p, jnp.float32)
     sample = jax.jit(lambda key: eng._sample(
-        tiled, key, temps, topks, topps, sampling_on=True))
+        tiled, key, temps, topks, topps, sampling_on=True)[0])
     out = [np.asarray(sample(jax.random.PRNGKey(1000 + i)))
            for i in range(reps)]
     return np.concatenate(out)[:n]
@@ -163,7 +163,7 @@ def test_greedy_rows_unaffected_by_sampling_rows(eng, logits):
     topks = jnp.zeros((batch,), jnp.int32)
     topps = jnp.ones((batch,), jnp.float32)
     out = np.asarray(eng._sample(tiled, jax.random.PRNGKey(0), temps,
-                                 topks, topps, sampling_on=True))
+                                 topks, topps, sampling_on=True)[0])
     argmax = int(np.argmax(np.asarray(logits)))
     assert all(out[i] == argmax for i in range(0, batch, 2))
 
