@@ -353,3 +353,16 @@ def test_chat_logprobs_schema(server):
     assert all(set(e) == {'token', 'logprob'} for e in content)
     assert (''.join(e['token'] for e in content)
             == out['choices'][0]['message']['content'])
+
+
+def test_load_tokenizer_edge_cases(tmp_path):
+    """No assets -> None; corrupt tokenizer.json -> None (warned), so
+    the server falls back to rejecting text rather than crashing."""
+    assert tokenizer_lib.load_tokenizer(None) is None
+    empty = tmp_path / 'empty'
+    empty.mkdir()
+    assert tokenizer_lib.load_tokenizer(str(empty)) is None
+    corrupt = tmp_path / 'corrupt'
+    corrupt.mkdir()
+    (corrupt / 'tokenizer.json').write_text('{not json')
+    assert tokenizer_lib.load_tokenizer(str(corrupt)) is None
