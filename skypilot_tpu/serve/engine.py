@@ -215,6 +215,11 @@ class Engine:
         def out_s(*specs):
             return None if mesh is None else specs
 
+        # Single-array-output jit: pass the sharding directly (the
+        # out_s tuple helper is for multi-output programs).
+        self._score_jit = jax.jit(
+            functools.partial(self._score_impl, cfg=model_cfg),
+            out_shardings=out_s(repl, repl, repl))
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
             static_argnames=('sampling_on',),
@@ -310,6 +315,48 @@ class Engine:
                                    axis=-1).astype(jnp.int32)
         chosen = jnp.where(temps <= 0, greedy, s)
         return chosen, logprob_of(chosen)
+
+    def _score_impl(self, params, tokens, cfg):
+        """Teacher-forced scoring: tokens [1, S_bucket] ->
+        ([S] logprob of each ACTUAL token given its prefix,
+         [S] argmax token id at each position, [S] its logprob) —
+        position 0 has no prefix (zero placeholders); padding positions
+        are garbage the host slices off. One forward, no KV cache."""
+        # return_kv=True is the SERVING forward contract for every
+        # model family ((logits, kv) — and it pins the MoE drop-free
+        # capacity, so scoring never capacity-drops a token); the tiny
+        # kv is discarded.
+        logits, _kv = self.model.forward(params, tokens, cfg,
+                                         return_kv=True)
+        logits = logits[0].astype(jnp.float32)          # [S, V]
+        logsm = logits - jax.nn.logsumexp(logits, axis=-1,
+                                          keepdims=True)
+        nxt = jnp.take_along_axis(logsm[:-1], tokens[0, 1:, None],
+                                  axis=-1)[:, 0]        # [S-1]
+        zero = jnp.zeros((1,), jnp.float32)
+        return (jnp.concatenate([zero, nxt]),
+                jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                 jnp.argmax(logsm[:-1], axis=-1)
+                                 .astype(jnp.int32)]),
+                jnp.concatenate([zero, jnp.max(logsm[:-1], axis=-1)]))
+
+    def score(self, prompt: Sequence[int]):
+        """Teacher-forced per-token scoring of `prompt` (the OpenAI
+        `echo=true, max_tokens=0, logprobs` path eval harnesses drive).
+        Returns (logprobs, argmax_ids, argmax_logprobs) — index 0 is a
+        placeholder (no prefix). The argmax pair is what loglikelihood
+        clients use for `is_greedy`. Bucket-padded like prefill: one
+        executable per bucket."""
+        self._validate(prompt)
+        bucket = self._bucket(len(prompt))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        logps, top_ids, top_lps = jax.device_get(
+            self._score_jit(self.params, jnp.asarray(padded)))
+        n = len(prompt)
+        return ([float(x) for x in np.asarray(logps)[:n]],
+                [int(x) for x in np.asarray(top_ids)[:n]],
+                [float(x) for x in np.asarray(top_lps)[:n]])
 
     def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
                       topp, cfg, sampling_on):

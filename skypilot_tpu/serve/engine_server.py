@@ -215,6 +215,18 @@ class ModelServer:
     def _decode_text(self, toks: List[int]) -> str:
         return self.tokenizer.decode(toks) if self.tokenizer else ''
 
+    def _token_strs(self, toks: List[int]) -> List[str]:
+        """Per-token text as incremental-decode DIFFS: the strings
+        concatenate exactly to decode(toks) (isolated per-id decode
+        loses BPE word-boundary spacing)."""
+        if self.tokenizer is None:
+            return ['' for _ in toks]
+        dec = tokenizer_lib.StreamDecoder(self.tokenizer)
+        out = [dec.push(t) for t in toks]
+        if out:
+            out[-1] += dec.flush()
+        return out
+
     # -- server ------------------------------------------------------- #
 
     def serve_forever(self) -> None:
@@ -357,8 +369,17 @@ class ModelServer:
                             chat: bool) -> None:
                 max_new = int(req.get('max_tokens',
                                       req.get('max_new_tokens', 64)))
+                if (not chat and max_new == 0 and req.get('echo')
+                        and req.get('logprobs')):
+                    # Teacher-forced scoring (the lm-eval-harness
+                    # loglikelihood path): no generation, just the
+                    # prompt's own per-token logprobs.
+                    self._score_prompt(req, tokens)
+                    return
                 if max_new <= 0:
-                    raise _BadRequest('max_tokens must be positive')
+                    raise _BadRequest(
+                        'max_tokens must be positive (0 is valid only '
+                        'with echo=true and logprobs for scoring)')
                 sampling = server._sampling_from(req)
                 stop = req.get('stop')
                 if isinstance(stop, str):
@@ -402,18 +423,9 @@ class ModelServer:
                     finish = 'stop'
                 logprobs_obj = None
                 if want_logprobs:
-                    # Per-token strings are incremental-decode DIFFS so
-                    # they concatenate exactly to the choice text
-                    # (isolated per-id decode loses BPE word-boundary
-                    # spacing); a stop-sequence cut truncates the token
-                    # list to the kept text the same way.
-                    token_strs: List[str] = []
-                    dec = (tokenizer_lib.StreamDecoder(server.tokenizer)
-                           if server.tokenizer else None)
-                    for t in toks:
-                        token_strs.append(dec.push(t) if dec else '')
-                    if dec is not None and token_strs:
-                        token_strs[-1] += dec.flush()
+                    # A stop-sequence cut truncates the token list to
+                    # the kept text.
+                    token_strs = server._token_strs(toks)
                     kept_lps = [round(p, 6) for p in logps]
                     if cut >= 0:
                         kept, acc = [], 0
@@ -436,6 +448,30 @@ class ModelServer:
                             'token_logprobs': kept_lps,
                             'top_logprobs': None,
                         }
+                if not chat and req.get('echo'):
+                    # OpenAI echo semantics: the prompt is part of the
+                    # returned text (and of the logprobs arrays, via
+                    # the teacher-forced scoring pass).
+                    text = server._decode_text(tokens) + text
+                    if logprobs_obj is not None:
+                        p_lps, p_ids, p_tops = server.engine.score(
+                            tokens)
+                        p_strs = server._token_strs(tokens)
+                        logprobs_obj = {
+                            'tokens': p_strs + logprobs_obj['tokens'],
+                            'token_logprobs':
+                                [None] + [round(p, 6)
+                                          for p in p_lps[1:]]
+                                + logprobs_obj['token_logprobs'],
+                            'top_logprobs':
+                                [None] + [
+                                    {server._decode_text([i]):
+                                     round(p, 6)}
+                                    for i, p in zip(p_ids[1:],
+                                                    p_tops[1:])]
+                                + [None] * len(
+                                    logprobs_obj['tokens']),
+                        }
                 if chat:
                     choice = {'index': 0,
                               'message': {'role': 'assistant',
@@ -454,6 +490,44 @@ class ModelServer:
                     'usage': {'prompt_tokens': len(tokens),
                               'completion_tokens': len(toks),
                               'total_tokens': len(tokens) + len(toks)}})
+
+            def _score_prompt(self, req, tokens: List[int]) -> None:
+                """echo=true, max_tokens=0, logprobs: per-token
+                logprobs of the PROMPT itself (teacher-forced, one
+                forward pass — no decode slots consumed)."""
+                if bool(req.get('stream', False)):
+                    raise _BadRequest('echo scoring does not stream')
+                logps, top_ids, top_lps = server.engine.score(tokens)
+                token_strs = server._token_strs(tokens)
+                text = server._decode_text(tokens)
+                offsets, acc = [], 0
+                for ts in token_strs:
+                    offsets.append(acc)
+                    acc += len(ts)
+                # top_logprobs: the argmax alternative per position —
+                # loglikelihood clients compute `is_greedy` from it.
+                tops = [None] + [
+                    {server._decode_text([i]): round(p, 6)}
+                    for i, p in zip(top_ids[1:], top_lps[1:])]
+                self._json(200, {
+                    'id': f'cmpl-{int(time.time()*1000)}',
+                    'object': 'text_completion',
+                    'created': int(time.time()),
+                    'model': server.model_name,
+                    'choices': [{
+                        'index': 0, 'text': text,
+                        'logprobs': {
+                            'tokens': token_strs,
+                            'token_logprobs':
+                                [None] + [round(p, 6)
+                                          for p in logps[1:]],
+                            'top_logprobs': tops,
+                            'text_offset': offsets,
+                        },
+                        'finish_reason': 'stop'}],
+                    'usage': {'prompt_tokens': len(tokens),
+                              'completion_tokens': 0,
+                              'total_tokens': len(tokens)}})
 
             # -- streaming -------------------------------------------- #
 

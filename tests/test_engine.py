@@ -515,3 +515,36 @@ def test_generate_batch_logprobs_match_forward():
         want = logsm[len(prompt) - 1 + i, tok]
         assert abs(lp - want) < 5e-3, (i, lp, want)
         assert lp <= 0.0
+
+
+def test_score_matches_forward_log_softmax():
+    """Teacher-forced scoring equals the model's log-softmax at each
+    actual next token (the lm-eval loglikelihood contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import llama as llama_lib
+    cfg = llama_lib.LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0,
+        dtype=jnp.float32, remat=False, use_flash_attention=False)
+    params = llama_lib.init_params(jax.random.PRNGKey(0), cfg)
+    eng = engine_lib.Engine(
+        cfg, params, engine_lib.EngineConfig(
+            batch_size=1, max_decode_len=64, prefill_buckets=(8, 16)))
+    prompt = [3, 17, 99, 42, 7, 11]
+    logps, top_ids, top_lps = eng.score(prompt)
+    assert len(logps) == len(prompt) and logps[0] == 0.0
+    assert len(top_ids) == len(prompt) == len(top_lps)
+    logits = np.asarray(llama_lib.forward(
+        params, jnp.asarray([prompt], jnp.int32), cfg))[0]
+    m = logits.max(-1, keepdims=True)
+    logsm = logits - m - np.log(np.exp(logits - m).sum(-1,
+                                                       keepdims=True))
+    for i in range(1, len(prompt)):
+        want = logsm[i - 1, prompt[i]]
+        assert abs(logps[i] - want) < 5e-3, (i, logps[i], want)
+        # top_logprobs really are the argmax alternatives.
+        assert top_ids[i] == int(np.argmax(logsm[i - 1]))
+        assert abs(top_lps[i] - logsm[i - 1].max()) < 5e-3

@@ -366,3 +366,63 @@ def test_load_tokenizer_edge_cases(tmp_path):
     corrupt.mkdir()
     (corrupt / 'tokenizer.json').write_text('{not json')
     assert tokenizer_lib.load_tokenizer(str(corrupt)) is None
+
+
+def test_echo_scoring_endpoint(server):
+    """echo=true + max_tokens=0 + logprobs scores the prompt itself
+    (teacher-forced) — first token logprob is null, the rest negative,
+    token strings concatenate to the echoed text."""
+    status, out = _post(server.port, '/v1/completions',
+                        {'prompt': 'hello world', 'max_tokens': 0,
+                         'echo': True, 'logprobs': True})
+    assert status == 200
+    lp = out['choices'][0]['logprobs']
+    assert lp['token_logprobs'][0] is None
+    assert all(p < 0 for p in lp['token_logprobs'][1:])
+    assert ''.join(lp['tokens']) == out['choices'][0]['text']
+    assert out['usage']['completion_tokens'] == 0
+    # max_tokens=0 without echo/logprobs is still rejected.
+    status, _ = _post(server.port, '/v1/completions',
+                      {'prompt': 'hello', 'max_tokens': 0})
+    assert status == 400
+
+
+def test_echo_scoring_has_top_logprobs_and_offsets(server):
+    """lm-eval's is_greedy path needs top_logprobs dicts + text_offset."""
+    status, out = _post(server.port, '/v1/completions',
+                        {'prompt': 'hello world', 'max_tokens': 0,
+                         'echo': True, 'logprobs': True})
+    assert status == 200
+    lp = out['choices'][0]['logprobs']
+    assert lp['top_logprobs'][0] is None
+    assert all(isinstance(d, dict) and len(d) == 1
+               for d in lp['top_logprobs'][1:])
+    # Greedy argmax logprob >= the actual token's logprob everywhere.
+    for d, actual in zip(lp['top_logprobs'][1:],
+                         lp['token_logprobs'][1:]):
+        assert next(iter(d.values())) >= actual - 1e-6
+    assert lp['text_offset'][0] == 0
+    assert lp['text_offset'] == sorted(lp['text_offset'])
+
+
+def test_echo_with_generation(server):
+    """echo=true with max_tokens>0 prepends the prompt to the text and
+    to the logprobs arrays (prompt scored teacher-forced)."""
+    prompt = 'hello world'
+    status, plain = _post(server.port, '/v1/completions',
+                          {'prompt': prompt, 'max_tokens': 4})
+    status, out = _post(server.port, '/v1/completions',
+                        {'prompt': prompt, 'max_tokens': 4,
+                         'echo': True, 'logprobs': True})
+    assert status == 200
+    text = out['choices'][0]['text']
+    prompt_text = server.tokenizer.decode(
+        server.tokenizer.encode(prompt))
+    assert text.startswith(prompt_text)
+    assert text.endswith(plain['choices'][0]['text'])
+    lp = out['choices'][0]['logprobs']
+    n_prompt = len(server.tokenizer.encode(prompt))
+    assert lp['token_logprobs'][0] is None
+    assert len(lp['tokens']) == n_prompt + out['usage'][
+        'completion_tokens']
+    assert ''.join(lp['tokens']) == text
