@@ -1,0 +1,186 @@
+"""Request-level online serving benchmark (client harness).
+
+The reference's headline serving number is REQUEST-level: JetStream's
+benchmark script drives 100 concurrent HTTP requests through the model
+server and reports req/s and output tok/s (reference
+examples/tpu/v6e/README.md:110-120 — 11.42 req/s, 2148 output tok/s,
+8.75 s wallclock). This module is the in-framework equivalent for
+`serve.engine_server`: N concurrent clients stream `/v1/completions`
+(SSE) and the harness reports req/s, output tok/s, time-to-first-token
+and inter-token latency percentiles — the numbers online serving is
+actually judged by, which the offline `generate_batch` path cannot see
+(per-step host sync, slot refill, prefill/decode interleaving all only
+exist in the online loop).
+
+Pure stdlib client (http.client + threads): the harness must not need
+the server's own event loop, and it runs anywhere the CPU-tier tests
+do.
+"""
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ok: bool
+    start_s: float
+    end_s: float
+    n_tokens: int = 0
+    ttft_s: Optional[float] = None
+    itl_s: List[float] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+
+
+def _percentile(xs: Sequence[float], p: float) -> Optional[float]:
+    """Nearest-rank percentile, rounded; None on empty input (NaN is
+    not valid strict JSON, and the BENCH artifact must stay
+    machine-readable). No numpy: the client harness stays
+    dependency-free."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+    return round(s[idx], 4)
+
+
+def _stream_one(host: str, port: int, payload: Dict[str, Any],
+                timeout_s: float) -> RequestResult:
+    """POST /v1/completions with stream=true; timestamp every SSE data
+    frame as it arrives off the socket. TTFT/ITL come from the text
+    frames (what a streaming client observes); the token COUNT comes
+    from the final stream_options.include_usage chunk — text deltas do
+    not map 1:1 to tokens (a multi-byte token can buffer in the
+    incremental decoder and emit nothing)."""
+    t0 = time.perf_counter()
+    res = RequestResult(ok=False, start_s=t0, end_s=t0)
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        body = json.dumps({**payload, 'stream': True,
+                           'stream_options': {'include_usage': True}})
+        conn.request('POST', '/v1/completions', body=body,
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            res.error = f'HTTP {resp.status}: {resp.read()[:200]!r}'
+            res.end_s = time.perf_counter()
+            return res
+        last_tok_t = None
+        buf = b''
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                break
+            now = time.perf_counter()
+            first_text_in_chunk = True
+            buf += chunk
+            while b'\n' in buf:
+                line, buf = buf.split(b'\n', 1)
+                line = line.strip()
+                if not line.startswith(b'data:'):
+                    continue
+                data = line[len(b'data:'):].strip()
+                if data == b'[DONE]':
+                    continue
+                try:
+                    frame = json.loads(data)
+                except json.JSONDecodeError:
+                    continue
+                if 'error' in frame:
+                    # In-band rejection (SSE headers already sent, so
+                    # the server can only report errors as frames).
+                    res.error = str(frame['error'])[:200]
+                    continue
+                if 'usage' in frame and not frame.get('choices'):
+                    res.n_tokens = int(
+                        frame['usage']['completion_tokens'])
+                    continue
+                choices = frame.get('choices') or []
+                # A text frame marks observable progress; the final
+                # finish_reason-only frame is not one. Frames sharing
+                # one socket read arrived together (TCP coalescing):
+                # they are ONE latency observation, not a burst of
+                # zero-length intervals that would deflate the ITL
+                # percentiles.
+                if choices and choices[0].get('text', '') != '':
+                    if res.ttft_s is None:
+                        res.ttft_s = now - t0
+                    elif (last_tok_t is not None
+                          and first_text_in_chunk):
+                        res.itl_s.append(now - last_tok_t)
+                    first_text_in_chunk = False
+                    last_tok_t = now
+        res.ok = res.n_tokens > 0
+        if not res.ok:
+            res.error = res.error or 'stream produced no tokens'
+    except Exception as e:  # noqa: BLE001 — recorded per-request
+        res.error = f'{type(e).__name__}: {e}'
+    finally:
+        conn.close()
+        res.end_s = time.perf_counter()
+    return res
+
+
+def run_benchmark(host: str, port: int,
+                  prompts: Sequence[Any],
+                  max_tokens: int = 64,
+                  concurrency: int = 16,
+                  timeout_s: float = 300.0,
+                  extra: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Drive every prompt through the server with at most `concurrency`
+    in flight; returns the metrics block (all latencies in seconds).
+    `prompts` entries are passed as the OpenAI `prompt` field (str or
+    token-id list)."""
+    results: List[Optional[RequestResult]] = [None] * len(prompts)
+    sem = threading.Semaphore(concurrency)
+
+    def worker(i: int, prompt: Any) -> None:
+        with sem:
+            payload = {'prompt': prompt, 'max_tokens': max_tokens,
+                       **(extra or {})}
+            results[i] = _stream_one(host, port, payload, timeout_s)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i, p), daemon=True)
+               for i, p in enumerate(prompts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout_s + 60)
+    wall = time.perf_counter() - t0
+
+    done = [r for r in results if r is not None]
+    ok = [r for r in done if r.ok]
+    total_tokens = sum(r.n_tokens for r in ok)
+    ttfts = [r.ttft_s for r in ok if r.ttft_s is not None]
+    itls = [x for r in ok for x in r.itl_s]
+    lats = [r.end_s - r.start_s for r in ok]
+    report: Dict[str, Any] = {
+        'num_requests': len(prompts),
+        'num_ok': len(ok),
+        'concurrency': concurrency,
+        'max_tokens': max_tokens,
+        'wall_s': round(wall, 3),
+        'req_per_s': round(len(ok) / wall, 2) if wall > 0 else 0.0,
+        'output_tok_per_s': round(total_tokens / wall, 1)
+        if wall > 0 else 0.0,
+        'total_output_tokens': total_tokens,
+        'ttft_p50_s': _percentile(ttfts, 50),
+        'ttft_p99_s': _percentile(ttfts, 99),
+        'itl_p50_s': _percentile(itls, 50),
+        'itl_p99_s': _percentile(itls, 99),
+        'latency_p50_s': _percentile(lats, 50),
+        'latency_p99_s': _percentile(lats, 99),
+    }
+    errors = [r.error for r in done if not r.ok and r.error]
+    if errors:
+        report['errors'] = errors[:5]
+    if len(ok) != len(prompts):
+        report['num_failed'] = len(prompts) - len(ok)
+    return report

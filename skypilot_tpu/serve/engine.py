@@ -73,6 +73,12 @@ class EngineConfig:
     # exact whenever the nucleus fits in this many candidates. Larger
     # pools cost a wider per-step lax.top_k over the vocab.
     max_topk: int = 64
+    # Online loop fairness: at most this many waiting requests are
+    # admitted (prefilled) between consecutive decode steps, so a burst
+    # of arrivals cannot stall every in-flight stream for the whole
+    # burst's prefill time — the JetStream-style prefill/decode
+    # interleave. 0 = unlimited (drain the waiting queue each step).
+    max_admit_per_step: int = 4
 
 
 @dataclasses.dataclass(frozen=True)
@@ -596,9 +602,14 @@ class Engine:
                 out[sid] = (int(toks_np[j]), float(logps_np[j]))
         return out
 
-    def decode(self):
-        """One decode step for every slot; returns ([B] tokens,
-        [B] logprobs)."""
+    def decode_dispatch(self):
+        """Dispatch one decode step for every slot WITHOUT reading the
+        result back: returns ([B] tokens, [B] logprobs) device arrays.
+        JAX dispatch is async, so the caller can overlap the device
+        step with host work (run_loop reads step N's tokens while the
+        device computes step N+1 — through a remote-execution relay the
+        read is a network round trip, which would otherwise serialize
+        with every step)."""
         self._key, sub = jax.random.split(self._key)
         next_tokens, logps, self._cache, self._lengths = self._decode_jit(
             self.params, self._cache, self._lengths, self._tokens, sub,
@@ -606,7 +617,12 @@ class Engine:
             sampling_on=bool((self._host_temps > 0).any()))
         self._tokens = next_tokens
         self._step_count += 1
-        toks_np, logps_np = jax.device_get((next_tokens, logps))
+        return next_tokens, logps
+
+    def decode(self):
+        """One decode step for every slot; returns ([B] tokens,
+        [B] logprobs)."""
+        toks_np, logps_np = jax.device_get(self.decode_dispatch())
         return np.asarray(toks_np), np.asarray(logps_np)
 
     def decode_many(self, k: int):
@@ -737,13 +753,33 @@ class Engine:
         stream (token, logprob) pairs into out_queue (an Exception then
         None on invalid input; None terminates the stream), refill
         slots as they free up in strict arrival order. Idles (blocking
-        get) when no request is in flight."""
+        get) when no request is in flight.
+
+        Two throughput disciplines on top of the naive
+        admit/decode/read cycle:
+
+        * **One-step dispatch-ahead**: each iteration dispatches decode
+          step N+1 BEFORE reading step N's tokens, so the device
+          computes while the host pays the transfer round trip and the
+          bookkeeping — inter-token latency becomes max(step, RTT)
+          instead of step + RTT. A slot that finishes at step N already
+          has a step-N+1 token in flight; it is dropped on read via an
+          object-identity check (same wasted-slot-step tradeoff the
+          offline chunked path accepts), and a slot refilled in between
+          cannot inherit it.
+        * **Capped admission** (EngineConfig.max_admit_per_step): a
+          burst of arrivals is prefetched a few requests per decode
+          step instead of stalling every in-flight stream for the whole
+          burst's prefill time.
+        """
         slots: Dict[int, _Slot] = {}
         waiting: collections.deque = collections.deque()
         next_id = 0
+        # (device token/logp arrays, {slot_id: _Slot at dispatch time})
+        inflight: Optional[Tuple[Any, Dict[int, _Slot]]] = None
         while not stop.is_set():
             # Drain the queue into a local FIFO (block only when idle).
-            block = not slots and not waiting
+            block = not slots and not waiting and inflight is None
             try:
                 while True:
                     item = request_queue.get(block=block, timeout=0.2)
@@ -764,7 +800,10 @@ class Engine:
                     if s not in slots]
             wave = []
             meta = {}
-            while waiting and free:
+            budget = (self.cfg.max_admit_per_step
+                      if self.cfg.max_admit_per_step > 0
+                      else self.cfg.batch_size)
+            while waiting and free and len(wave) < budget:
                 item = waiting.popleft()
                 prompt, max_new, out_q = item[0], item[1], item[2]
                 sp = item[3] if len(item) > 3 else None
@@ -806,15 +845,26 @@ class Engine:
                     if out_q is not None and not self._is_eos(first):
                         out_q.put((first, first_logp))
                     self._finish_if_done(slots, slot_id, None)
-            if not slots:
-                continue
-            tokens, logps = self.decode()
-            for slot_id in list(slots):
-                slot = slots[slot_id]
-                tok = int(tokens[slot_id])
-                slot.tokens.append(tok)
-                slot.logprobs.append(float(logps[slot_id]))
-                if not self._is_eos(tok):
-                    if slot.out_queue is not None:
-                        slot.out_queue.put((tok, float(logps[slot_id])))
-                self._finish_if_done(slots, slot_id, None)
+            # Dispatch step N+1 (device starts computing now) ...
+            next_inflight = None
+            if slots:
+                next_inflight = (self.decode_dispatch(), dict(slots))
+            # ... then read + process step N while it runs.
+            if inflight is not None:
+                handles, live = inflight
+                tokens, logps = jax.device_get(handles)
+                tokens, logps = np.asarray(tokens), np.asarray(logps)
+                for slot_id, slot in live.items():
+                    if slots.get(slot_id) is not slot:
+                        # Finished (or refilled) after this step was
+                        # dispatched: its row is a wasted slot-step.
+                        continue
+                    tok = int(tokens[slot_id])
+                    slot.tokens.append(tok)
+                    slot.logprobs.append(float(logps[slot_id]))
+                    if not self._is_eos(tok):
+                        if slot.out_queue is not None:
+                            slot.out_queue.put((tok,
+                                                float(logps[slot_id])))
+                    self._finish_if_done(slots, slot_id, None)
+            inflight = next_inflight

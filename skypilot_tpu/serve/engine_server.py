@@ -406,10 +406,20 @@ class ModelServer:
                 rid = (f'chatcmpl-{int(time.time()*1000)}' if chat
                        else f'cmpl-{int(time.time()*1000)}')
                 created = int(time.time())
+                stream_opts = req.get('stream_options', {})
+                if not isinstance(stream_opts, dict):
+                    raise _BadRequest('stream_options must be an object')
+                if stream_opts and not bool(req.get('stream', False)):
+                    raise _BadRequest(
+                        'stream_options is only allowed when '
+                        'stream is true')
                 out_q = self._enqueue(tokens, max_new, sampling)
                 if bool(req.get('stream', False)):
-                    self._stream_openai(out_q, rid, created, chat, stop,
-                                        max_new)
+                    self._stream_openai(
+                        out_q, rid, created, chat, stop, max_new,
+                        n_prompt=len(tokens),
+                        include_usage=bool(
+                            stream_opts.get('include_usage')))
                     return
                 toks, logps, error = self._collect(out_q)
                 if error is not None:
@@ -585,14 +595,20 @@ class ModelServer:
             def _stream_openai(self, out_q: 'queue.Queue', rid: str,
                                created: int, chat: bool,
                                stop: Optional[List[str]],
-                               max_new: int) -> None:
+                               max_new: int, n_prompt: int = 0,
+                               include_usage: bool = False) -> None:
                 """OpenAI SSE chunk framing. Stop sequences are matched
                 host-side on the cumulative text; text that could still
                 be the PREFIX of a stop string is held back (a stop
                 string spanning two deltas must not leak its first
                 half), so stream and non-stream agree. On a match the
                 stream ends early (the engine finishes into the
-                orphaned queue)."""
+                orphaned queue). With stream_options.include_usage
+                (OpenAI parity) a final usage chunk with empty
+                `choices` precedes [DONE] — the only faithful token
+                count a streaming client can get, since text deltas do
+                not map 1:1 to tokens (a multi-byte UTF-8 token can
+                buffer in the incremental decoder and emit no frame)."""
                 self._sse_headers()
                 obj = 'chat.completion.chunk' if chat else 'text_completion'
 
@@ -671,6 +687,17 @@ class ModelServer:
                     finish = ('length' if n_tokens >= max_new
                               and not stopped else 'stop')
                     self._chunk(frame(None, finish))
+                    if include_usage:
+                        self._chunk(b'data: ' + json.dumps(
+                            {'id': rid, 'object': obj,
+                             'created': created,
+                             'model': server.model_name,
+                             'choices': [],
+                             'usage': {
+                                 'prompt_tokens': n_prompt,
+                                 'completion_tokens': n_tokens,
+                                 'total_tokens': n_prompt + n_tokens,
+                             }}).encode() + b'\n\n')
                     self._chunk(b'data: [DONE]\n\n')
                     self._chunk(b'')
                 except OSError:

@@ -1,0 +1,113 @@
+"""Request-level online serving benchmark harness, CPU tier.
+
+The reference's serving number is request-level (100 concurrent HTTP
+requests through JetStream — reference examples/tpu/v6e/README.md:
+110-120); benchmark/serving.py is the in-framework harness for it.
+This drives the harness against a real engine_server on the tiny
+model: concurrent SSE clients, metrics must be present and sane, and
+the dispatch-ahead run_loop must deliver every request's full token
+budget (no dropped or cross-wired streams under concurrency).
+"""
+import socket
+import threading
+
+from skypilot_tpu.benchmark import serving as serving_bench
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import engine_server
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _start_server(batch_size=4, max_admit_per_step=2):
+    eng = engine_lib.Engine(
+        llama.llama_tiny(),
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=batch_size, max_decode_len=64,
+            prefill_buckets=(8,), eos_id=-1,
+            max_admit_per_step=max_admit_per_step))
+    port = _free_port()
+    srv = engine_server.ModelServer.from_engine(eng, port,
+                                                model_name='tiny')
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    assert srv.ready.wait(timeout=300)
+    return srv, port
+
+
+def test_online_benchmark_metrics_and_completeness():
+    srv, port = _start_server()
+    try:
+        n, max_toks = 10, 12
+        prompts = [[1, 2, 3, 4] for _ in range(n)]
+        report = serving_bench.run_benchmark(
+            '127.0.0.1', port, prompts, max_tokens=max_toks,
+            concurrency=6, timeout_s=120)
+        assert report['num_ok'] == n, report
+        # eos_id=-1 (never stop): every stream must carry its full
+        # token budget through the pipelined loop.
+        assert report['total_output_tokens'] == n * max_toks, report
+        assert report['req_per_s'] > 0
+        assert report['output_tok_per_s'] > 0
+        assert report['ttft_p50_s'] > 0
+        assert report['ttft_p99_s'] >= report['ttft_p50_s']
+        assert report['itl_p50_s'] > 0
+        assert report['itl_p99_s'] >= report['itl_p50_s']
+        assert report['latency_p99_s'] <= report['wall_s'] + 1e-6
+    finally:
+        srv.shutdown()
+
+
+def test_online_benchmark_burst_exceeds_batch():
+    """More concurrent requests than decode slots: the capped-admission
+    loop must refill slots and finish everyone."""
+    srv, port = _start_server(batch_size=2, max_admit_per_step=1)
+    try:
+        n = 7
+        report = serving_bench.run_benchmark(
+            '127.0.0.1', port, [[5, 6] for _ in range(n)],
+            max_tokens=6, concurrency=n, timeout_s=120)
+        assert report['num_ok'] == n, report
+        assert report['total_output_tokens'] == n * 6, report
+    finally:
+        srv.shutdown()
+
+
+def test_stream_options_requires_stream():
+    """OpenAI parity: stream_options without stream=true is a 400."""
+    import http.client
+    import json
+    srv, port = _start_server()
+    try:
+        c = http.client.HTTPConnection('127.0.0.1', port, timeout=60)
+        c.request('POST', '/v1/completions',
+                  body=json.dumps({
+                      'prompt': [1, 2], 'max_tokens': 2,
+                      'stream_options': {'include_usage': True}}),
+                  headers={'Content-Type': 'application/json'})
+        resp = c.getresponse()
+        body = resp.read()
+        assert resp.status == 400, (resp.status, body)
+        assert b'stream_options' in body
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+def test_online_benchmark_reports_failures():
+    """A request the engine rejects (too-long prompt) is recorded as a
+    failure, not silently dropped from the denominator."""
+    srv, port = _start_server()
+    try:
+        report = serving_bench.run_benchmark(
+            '127.0.0.1', port,
+            [[1] * 4, [1] * 500],  # second exceeds every bucket
+            max_tokens=4, concurrency=2, timeout_s=120)
+        assert report['num_ok'] == 1
+        assert report.get('num_failed') == 1
+        assert report.get('errors'), report
+    finally:
+        srv.shutdown()
