@@ -55,11 +55,12 @@ def main():
     module = llama
     if args.hf_model:
         from skypilot_tpu.models import hf_convert
-        module, cfg, base, _eos = hf_convert.from_hf_auto(
+        module, cfg, base, hf_eos = hf_convert.from_hf_auto(
             args.hf_model)
     else:
         cfg = PRESETS[args.model]()
         base = llama.init_params(jax.random.PRNGKey(0), cfg)
+        hf_eos = None
     lcfg = lora.LoraConfig(rank=args.rank, alpha=args.alpha,
                            target_keys=tuple(
                                args.target_keys.split(',')))
@@ -95,13 +96,23 @@ def main():
             print(f'step {i + 1}: loss={float(metrics["loss"]):.4f} '
                   f'({time.perf_counter() - t0:.1f}s)')
     if args.merge_out:
-        from skypilot_tpu.train import checkpoints
+        from skypilot_tpu.models import native_ckpt
         merged = lora.merge(jax.device_get(base),
                             jax.device_get(state.params), lcfg)
-        ckpt = checkpoints.CheckpointManager(args.merge_out)
-        ckpt.save(int(state.step), {'params': merged})
-        ckpt.wait()   # async save must land before exit
-        print(f'merged checkpoint written to {args.merge_out}')
+        # Self-contained serving checkpoint: params + config + the
+        # source checkpoint's tokenizer assets — serve it directly with
+        # `engine_server --ckpt <merge_out>`.
+        family = ('mixtral' if module.__name__.endswith('mixtral')
+                  else 'llama')
+        # Keep the source checkpoint's EOS (Llama-3.1 declares a
+        # multi-EOS tuple; losing it would run generations to
+        # max_tokens when serving the merge).
+        native_ckpt.save_serving_ckpt(
+            args.merge_out, cfg, merged, model_family=family,
+            eos_id=hf_eos, tokenizer_src=args.hf_model)
+        print(f'merged serving checkpoint written to {args.merge_out} '
+              f'(serve: python3 -m skypilot_tpu.serve.engine_server '
+              f'--ckpt {args.merge_out})')
 
 
 if __name__ == '__main__':

@@ -1,0 +1,125 @@
+"""Native (orbax) serving checkpoints: params + model config + tokenizer
+assets in one directory the serving engine loads directly.
+
+Closes the finetune→serve loop in-framework: `train/lora.py` merges
+adapters into a plain parameter tree, `save_serving_ckpt` writes it
+(orbax) alongside the model config and the source checkpoint's
+tokenizer assets, and `engine_server --ckpt DIR` serves it — no HF
+round trip. The reference's recipes hand off between stages only via
+HF-format checkpoints on disk (reference
+llm/llama-3_1-finetuning/lora.yaml writes torchtune output the serve
+recipe re-reads); this path exists because our trainer and engine
+share one parameter schema.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+CONFIG_FILE = 'model_config.json'
+# Copied verbatim so the serving dir is self-contained for text/chat.
+TOKENIZER_ASSETS = ('tokenizer.json', 'tokenizer_config.json',
+                    'special_tokens_map.json', 'tokenizer.model')
+_FAMILIES = ('llama', 'mixtral')
+
+
+def _module_for(family: str):
+    if family == 'llama':
+        from skypilot_tpu.models import llama
+        return llama
+    if family == 'mixtral':
+        from skypilot_tpu.models import mixtral
+        return mixtral
+    raise ValueError(
+        f'unknown model_family {family!r} (expected one of {_FAMILIES})')
+
+
+def _cfg_to_dict(cfg: Any) -> dict:
+    d = dataclasses.asdict(cfg)
+    # dtype is a jnp type object; store its canonical name.
+    d['dtype'] = jnp.dtype(cfg.dtype).name
+    return d
+
+
+def _cfg_from_dict(family: str, d: dict) -> Any:
+    d = dict(d)
+    d['dtype'] = jnp.dtype(d['dtype']).type
+    if family == 'llama':
+        from skypilot_tpu.models import llama
+        if d.get('rope_scaling') is not None:
+            d['rope_scaling'] = llama.RopeScaling(**d['rope_scaling'])
+        return llama.LlamaConfig(**d)
+    from skypilot_tpu.models import mixtral
+    return mixtral.MixtralConfig(**d)
+
+
+def save_serving_ckpt(directory: str, cfg: Any, params: Any,
+                      model_family: str = 'llama',
+                      eos_id: Any = None,
+                      tokenizer_src: Optional[str] = None) -> None:
+    """Write `params` (orbax, step 0) + model config + tokenizer assets
+    to `directory`. `tokenizer_src`: a checkpoint dir whose tokenizer
+    assets are copied in, so chat/text endpoints work against the
+    result without the original checkpoint."""
+    import jax
+
+    from skypilot_tpu.train import checkpoints
+    if model_family not in _FAMILIES:
+        raise ValueError(f'unknown model_family {model_family!r}')
+    directory = os.path.abspath(os.path.expanduser(directory))
+    mgr = checkpoints.CheckpointManager(directory, max_to_keep=1)
+    mgr.save(0, {'params': jax.device_get(params)})
+    mgr.close()
+    meta = {'model_family': model_family,
+            'eos_id': list(eos_id) if isinstance(eos_id, (tuple, list))
+            else eos_id,
+            'config': _cfg_to_dict(cfg)}
+    with open(os.path.join(directory, CONFIG_FILE), 'w') as f:
+        json.dump(meta, f, indent=1)
+    if tokenizer_src is not None:
+        src = os.path.abspath(os.path.expanduser(tokenizer_src))
+        for asset in TOKENIZER_ASSETS:
+            p = os.path.join(src, asset)
+            if os.path.exists(p):
+                shutil.copy(p, os.path.join(directory, asset))
+
+
+def load_serving_ckpt(directory: str
+                      ) -> Tuple[Any, Any, Any, Optional[Any]]:
+    """Returns (model_module, cfg, params, eos_id) from a
+    save_serving_ckpt directory. Params come back as host arrays; the
+    engine device_puts them per its sharding plan."""
+    from skypilot_tpu.train import checkpoints
+    directory = os.path.abspath(os.path.expanduser(directory))
+    cfg_path = os.path.join(directory, CONFIG_FILE)
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(
+            f'{cfg_path} not found: not a native serving checkpoint '
+            '(write one with models.native_ckpt.save_serving_ckpt, '
+            'e.g. finetune_lora.py --merge-out)')
+    with open(cfg_path) as f:
+        meta = json.load(f)
+    family = meta['model_family']
+    module = _module_for(family)
+    cfg = _cfg_from_dict(family, meta['config'])
+    eos = meta.get('eos_id')
+    if isinstance(eos, list):
+        eos = tuple(eos)
+    mgr = checkpoints.CheckpointManager(directory, max_to_keep=1)
+    step, tree = mgr.restore_latest_raw()
+    mgr.close()
+    if step is None:
+        raise FileNotFoundError(
+            f'no checkpoint steps under {directory}')
+    logger.info('loaded native serving checkpoint %s (step %s, %s)',
+                directory, step, family)
+    return module, cfg, tree['params'], eos

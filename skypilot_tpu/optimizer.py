@@ -2,9 +2,12 @@
 per task.
 
 Reference equivalent: sky/optimizer.py (1345 LoC: DP over chains at :411, ILP
-via pulp for general DAGs at :472). Our Dag is a chain by construction and
-tasks have no inter-task egress in the TPU-first design (data moves via GCS),
-so per-task independent minimization IS the chain DP — no ILP needed.
+via pulp for general DAGs at :472, parent->child egress model at :77-108).
+Per-task minimization is exact for independent tasks; tasks coupled by
+data-bearing edges get a JOINT region assignment (exhaustive over the
+data-connected tasks — exact, like the reference's ILP, at the DAG sizes
+tasks actually have; CBC is not in this image) with a greedy per-child
+fallback above the enumeration budget.
 
 The output contract matches the reference (`task.best_resources` gets filled,
 optimizer.py:110): each task's `best_resources` becomes a *launchable*
@@ -96,21 +99,128 @@ EGRESS_USD_PER_GB = 0.01
 DEFAULT_RUNTIME_HOURS = 1.0
 
 
-def _apply_egress_placement(dag: dag_lib.Dag,
-                            plans: List[OptimizedPlan]) -> None:
-    """Egress-aware placement for DAG edges: when a child task's chosen
-    region differs from its parent's and the parent declares
-    `outputs: {estimated_size_gb: N}`, re-pin the child to the parent's
-    region if hourly-price-delta x runtime < one-off egress cost.
-    For each child the decision is made ONCE over all its parents
-    (candidate regions scored by run-cost PLUS total egress from every
-    data-bearing parent), children in topological order so a parent's
-    placement is final before its children look at it — per-edge greedy
-    would let a second parent re-move a child and silently re-incur the
-    first parent's egress. The winning region is ALSO pinned into
+def _repin(plan: OptimizedPlan, best: 'object') -> None:
+    """Move a plan onto offering `best` (a different region): reorder
+    failover candidates co-located-first, rebuild best_resources FROM
+    the offering (region alone is not enough — the cheapest same-region
+    candidate may be a different shape), and pin the region into
     task.resources (the durable spec): managed jobs re-optimize each
     task independently on the controller (execution.launch), and only
     the spec-level pin survives the dag YAML round trip."""
+    same_region = [o for o in plan.candidates
+                   if o.region == best.region]
+    plan.chosen = best
+    plan.candidates = same_region + [
+        o for o in plan.candidates if o not in same_region]
+    res = plan.task.best_resources
+    if hasattr(best, 'topology'):
+        plan.task.best_resources = res.copy(
+            tpu=best.topology, region=best.region)
+    else:
+        plan.task.best_resources = res.copy(
+            instance_type=best.instance_type, region=best.region)
+    plan.task.resources = plan.task.resources.copy(region=best.region)
+    plan.hourly_cost = (best.price(plan.task.resources.use_spot)
+                        * plan.task.num_nodes)
+
+
+def _cheapest_per_region(plan: OptimizedPlan) -> dict:
+    """region -> cheapest offering (candidates are price-ascending)."""
+    regs: dict = {}
+    for o in plan.candidates:
+        regs.setdefault(o.region, o)
+    return regs
+
+
+# Enumeration budget for the joint solve: above this many region
+# assignments over the data-connected tasks, fall back to the greedy
+# per-child pass (the reference solves the general case with pulp/CBC,
+# sky/optimizer.py:472-607; CBC is not in this image, and exhaustive
+# search is exact at the DAG sizes tasks actually have).
+_JOINT_MAX_ASSIGNMENTS = 200_000
+
+
+def _joint_egress_placement(dag: dag_lib.Dag,
+                            plans: List[OptimizedPlan]) -> bool:
+    """JOINT placement over every task touching a data-bearing edge:
+    enumerate all region assignments and take the minimum of
+    run-cost + egress. Unlike the greedy child pass, this can move a
+    PARENT toward its siblings/children — the diamond a->{b,c}->d
+    where greedy pins b and c apart (each independently cheapest) and
+    then d pays one parent's egress no matter what; the joint optimum
+    co-locates all three when the price spread is below the egress.
+    Returns False when the assignment space exceeds the enumeration
+    budget (caller falls back to greedy)."""
+    import itertools
+
+    plan_by_task = {id(p.task): p for p in plans}
+    data_edges = [(p, c) for p, c in dag.edges()
+                  if p.estimated_output_gb]
+    if not data_edges:
+        return True                      # nothing to co-locate
+    nodes: dict = {}
+    for p, c in data_edges:
+        nodes[id(p)] = p
+        nodes[id(c)] = c
+    choices: dict = {}
+    total = 1
+    for tid, t in nodes.items():
+        plan = plan_by_task[tid]
+        if t.resources.region is not None:
+            # User pin always wins; candidates were already filtered
+            # to the pinned region by get_offerings.
+            regs = {t.resources.region: plan.chosen}
+        else:
+            regs = _cheapest_per_region(plan)
+        # Price-ascending per task, so enumeration meets each task's
+        # cheapest regions first and ties resolve to cheapest-first.
+        choices[tid] = list(regs.items())
+        total *= len(regs)
+        if total > _JOINT_MAX_ASSIGNMENTS:
+            return False
+    ids = list(nodes)
+    run_hours = DEFAULT_RUNTIME_HOURS
+    best_cost = float('inf')
+    best_assign = None
+    for combo in itertools.product(*(choices[tid] for tid in ids)):
+        assign = dict(zip(ids, combo))   # tid -> (region, offering)
+        cost = sum(
+            off.price(nodes[tid].resources.use_spot)
+            * nodes[tid].num_nodes * run_hours
+            for tid, (_reg, off) in assign.items())
+        for p, c in data_edges:
+            if assign[id(p)][0] != assign[id(c)][0]:
+                cost += p.estimated_output_gb * EGRESS_USD_PER_GB
+        if cost < best_cost - 1e-12:
+            best_cost = cost
+            best_assign = assign
+    moved = []
+    for tid, (region, off) in best_assign.items():
+        t = nodes[tid]
+        plan = plan_by_task[tid]
+        if t.resources.region is not None:
+            continue
+        if region != plan.task.best_resources.region:
+            _repin(plan, off)
+            moved.append(f'{t.name}->{region}')
+    if moved:
+        logger.info(
+            'joint egress placement over %d task(s) / %d data edge(s): '
+            'moved %s (total planned cost $%.2f incl. egress)',
+            len(nodes), len(data_edges), ', '.join(moved), best_cost)
+    return True
+
+
+def _apply_egress_placement(dag: dag_lib.Dag,
+                            plans: List[OptimizedPlan]) -> None:
+    """Greedy egress-aware placement (fallback above the joint solve's
+    enumeration budget): for each child task with data-bearing parents,
+    re-pin the child to the region minimizing run-cost + egress from
+    every such parent. Children in topological order so a parent's
+    placement is final before its children look at it — per-edge greedy
+    would let a second parent re-move a child and silently re-incur the
+    first parent's egress. Parents never move toward children here;
+    that cross-pull is exactly what the joint solve adds."""
     plan_by_task = {id(p.task): p for p in plans}
     by_child: dict = {}
     for parent, child in dag.edges():
@@ -132,40 +242,39 @@ def _apply_egress_placement(dag: dag_lib.Dag,
                        if plan_by_task[id(p)].task.best_resources.region
                        != region)
 
-        cheapest_in = {}
-        for o in c_plan.candidates:          # price-ascending
-            cheapest_in.setdefault(o.region, o)
         best = min(
-            cheapest_in.values(),
+            _cheapest_per_region(c_plan).values(),
             key=lambda o: (o.price(use_spot) * n * DEFAULT_RUNTIME_HOURS
                            + egress_to(o.region)))
         if best.region == c_plan.task.best_resources.region:
             continue
-        same_region = [o for o in c_plan.candidates
-                       if o.region == best.region]
-        c_plan.chosen = best
-        # Failover still roams: co-located candidates first.
-        c_plan.candidates = same_region + [
-            o for o in c_plan.candidates if o not in same_region]
-        # Rebuild best_resources FROM the new offering (mirror of
-        # optimize_task): region alone is not enough — the cheapest
-        # same-region candidate may be a different shape.
-        c_res = c_plan.task.best_resources
-        if hasattr(best, 'topology'):
-            c_plan.task.best_resources = c_res.copy(
-                tpu=best.topology, region=best.region)
-        else:
-            c_plan.task.best_resources = c_res.copy(
-                instance_type=best.instance_type, region=best.region)
-        # Durable pin (see docstring).
-        c_plan.task.resources = c_plan.task.resources.copy(
-            region=best.region)
-        c_plan.hourly_cost = best.price(use_spot) * n
+        _repin(c_plan, best)
         logger.info(
             'egress-aware placement: %r pinned to region %s (%d '
             'data-bearing parent(s); total remaining egress $%.2f)',
             child.name, best.region, len(parents),
             egress_to(best.region))
+
+
+def _warn_unpriced_edges(dag: dag_lib.Dag,
+                         plans: List[OptimizedPlan]) -> None:
+    """A DAG edge that ends up crossing regions with NO declared output
+    size moves data the optimizer priced at $0 — say so, naming the
+    edge, instead of silently treating the movement as free."""
+    plan_by_task = {id(p.task): p for p in plans}
+    for parent, child in dag.edges():
+        if parent.estimated_output_gb is not None:
+            continue
+        p_reg = plan_by_task[id(parent)].task.best_resources.region
+        c_reg = plan_by_task[id(child)].task.best_resources.region
+        if p_reg != c_reg:
+            logger.warning(
+                'DAG edge %r -> %r crosses regions (%s -> %s) with no '
+                'outputs.estimated_size_gb declared on %r: its data '
+                'movement is priced at $0. Declare '
+                'outputs: {estimated_size_gb: N} to let the optimizer '
+                'weigh the egress.',
+                parent.name, child.name, p_reg, c_reg, parent.name)
 
 
 def optimize(dag: dag_lib.Dag,
@@ -180,7 +289,9 @@ def optimize(dag: dag_lib.Dag,
     GCS)."""
     dag.resolve_edges()
     plans = [optimize_task(t, minimize) for t in dag.topological_order()]
-    _apply_egress_placement(dag, plans)
+    if not _joint_egress_placement(dag, plans):
+        _apply_egress_placement(dag, plans)
+    _warn_unpriced_edges(dag, plans)
     if not quiet:
         print(format_plan_table(plans))
     return plans

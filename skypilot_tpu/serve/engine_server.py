@@ -119,10 +119,42 @@ class ModelServer:
                  quantize: Optional[str] = None,
                  tp: int = 1,
                  hf_model: Optional[str] = None,
-                 kv_quantize: Optional[str] = None):
+                 kv_quantize: Optional[str] = None,
+                 ckpt: Optional[str] = None):
         params = None
         eos_id = EOS_ID
-        if hf_model is not None:
+
+        def adopt_checkpoint(path: str, ckpt_eos) -> int:
+            """Shared checkpoint-adoption tail: load the checkpoint's
+            tokenizer and resolve EOS — the checkpoint's declared EOS
+            (may be a multi-EOS tuple) wins, else the tokenizer's, else
+            the byte default (a Llama-3 vocab uses byte id 2 as an
+            ordinary BPE token, so the fallbacks matter)."""
+            self.tokenizer = tokenizer_lib.load_tokenizer(path)
+            self.model_name = path
+            if self.tokenizer is None:
+                logger.warning(
+                    'checkpoint %s ships no tokenizer asset: text '
+                    'prompts will be rejected (pass token ids)', path)
+            if ckpt_eos is not None:
+                return ckpt_eos
+            if (self.tokenizer is not None
+                    and self.tokenizer.eos_id is not None):
+                # config without an EOS declaration: the tokenizer
+                # assets still know the real EOS.
+                return self.tokenizer.eos_id
+            return EOS_ID
+
+        if ckpt is not None:
+            # Native serving checkpoint (orbax + model_config.json +
+            # tokenizer assets — models/native_ckpt.py): the output of
+            # finetune_lora.py --merge-out, served without an HF round
+            # trip.
+            from skypilot_tpu.models import native_ckpt
+            model_module, cfg, params, nk_eos = (
+                native_ckpt.load_serving_ckpt(ckpt))
+            eos_id = adopt_checkpoint(ckpt, nk_eos)
+        elif hf_model is not None:
             # Real checkpoint path (local dir or GCS mount): convert a
             # transformers LlamaForCausalLM to our functional params
             # (models/hf_convert.py); `model` preset is ignored.
@@ -132,22 +164,7 @@ class ModelServer:
             from skypilot_tpu.models import hf_convert
             model_module, cfg, params, hf_eos = hf_convert.from_hf_auto(
                 hf_model)
-            # The checkpoint's real EOS, not the byte-tokenizer's (a
-            # Llama-3 vocab uses id 2 as an ordinary BPE token).
-            self.tokenizer = tokenizer_lib.load_tokenizer(hf_model)
-            self.model_name = hf_model
-            if hf_eos is not None:
-                eos_id = hf_eos
-            elif (self.tokenizer is not None
-                  and self.tokenizer.eos_id is not None):
-                # config.json without eos_token_id: the tokenizer
-                # assets still know the real EOS.
-                eos_id = self.tokenizer.eos_id
-            if self.tokenizer is None:
-                logger.warning(
-                    'checkpoint %s ships no tokenizer asset: text '
-                    'prompts will be rejected (pass token ids)',
-                    hf_model)
+            eos_id = adopt_checkpoint(hf_model, hf_eos)
         else:
             cfg_factory, model_module = MODEL_PRESETS[model]
             cfg = cfg_factory()
@@ -756,12 +773,17 @@ def main() -> None:
                              'models/hf_convert.py; overrides --model; '
                              'loads the checkpoint tokenizer for the '
                              'text/chat endpoints)')
+    parser.add_argument('--ckpt', default=None,
+                        help='path to a native serving checkpoint '
+                             '(models/native_ckpt.py — e.g. '
+                             'finetune_lora.py --merge-out output); '
+                             'overrides --model/--hf-model')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
                 args.quantize, args.tp, args.hf_model,
-                args.kv_quantize).serve_forever()
+                args.kv_quantize, ckpt=args.ckpt).serve_forever()
 
 
 if __name__ == '__main__':

@@ -59,6 +59,18 @@ class CheckpointManager:
                     f'{self.directory}')
         return step, state
 
+    def restore_latest_raw(self) -> Tuple[Optional[int], Optional[Any]]:
+        """Restore the newest checkpoint WITHOUT a template, from the
+        structure metadata orbax stored at save time — for consumers
+        that don't know the tree up front (native serving checkpoints:
+        the engine learns the param dtypes/shapes from the checkpoint,
+        not the other way around). Returns (step, tree) or
+        (None, None)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None
+        return step, self._mgr.restore(step)
+
     def wait(self) -> None:
         """Block until in-flight async saves are durable (call before
         process exit, or the last save may be a torn partial)."""

@@ -201,3 +201,81 @@ def test_egress_pin_survives_managed_job_serialization(monkeypatch):
             break
         time.sleep(0.3)
     assert s == 'SUCCEEDED', s
+
+
+def test_joint_placement_moves_parent_toward_pinned_consumers():
+    """The greedy pass finalizes a parent's region before its children
+    weigh in: an unpinned producer `a` (cheapest region us-central1)
+    feeding consumers pinned to us-west1 and us-east1 would stay in
+    us-central1 and pay BOTH egresses. The joint solve moves `a` onto
+    one consumer's region (US regions price-tie), halving egress."""
+    dag = dag_lib.Dag()
+    a = _task('a', out_gb=100)
+    b = _task('b', ['a'], region='us-west1')
+    c = _task('c', ['a'], region='us-east1')
+    for t in (a, b, c):
+        dag.add(t)
+    plans = optimizer.optimize(dag, quiet=True)
+    by_name = {p.task.name: p for p in plans}
+    assert by_name['a'].task.best_resources.region in ('us-west1',
+                                                       'us-east1')
+    # The greedy fallback, by contrast, cannot move `a` at all.
+    dag2 = dag_lib.Dag()
+    a2 = _task('a', out_gb=100)
+    b2 = _task('b', ['a'], region='us-west1')
+    c2 = _task('c', ['a'], region='us-east1')
+    for t in (a2, b2, c2):
+        dag2.add(t)
+    dag2.resolve_edges()
+    plans2 = [optimizer.optimize_task(t)
+              for t in dag2.topological_order()]
+    optimizer._apply_egress_placement(dag2, plans2)
+    a2_region = next(p for p in plans2 if p.task.name == 'a'
+                     ).task.best_resources.region
+    assert a2_region not in ('us-west1', 'us-east1')
+
+
+def test_joint_placement_fallback_to_greedy(monkeypatch):
+    """Above the enumeration budget the joint solve declines and the
+    greedy child pass still co-locates data consumers."""
+    monkeypatch.setattr(optimizer, '_JOINT_MAX_ASSIGNMENTS', 1)
+    dag = dag_lib.Dag()
+    dag.add(_task('train', out_gb=100, region='us-west1'))
+    dag.add(_task('eval', ['train']))
+    plans = optimizer.optimize(dag, quiet=True)
+    by_name = {p.task.name: p for p in plans}
+    assert by_name['eval'].task.best_resources.region == 'us-west1'
+
+
+def test_warns_on_unpriced_cross_region_edge():
+    """A cross-region edge whose parent declares no output size moves
+    data priced at $0 — the optimizer must say so, naming the edge."""
+    import io
+    import logging
+    dag = dag_lib.Dag()
+    dag.add(_task('train', region='us-west1'))          # no outputs
+    dag.add(_task('eval', ['train'], region='us-east1'))
+    buf = io.StringIO()
+    handler = logging.StreamHandler(buf)
+    log = logging.getLogger('skypilot_tpu.optimizer')
+    log.addHandler(handler)
+    try:
+        optimizer.optimize(dag, quiet=True)
+    finally:
+        log.removeHandler(handler)
+    out = buf.getvalue()
+    assert 'train' in out and 'eval' in out
+    assert 'estimated_size_gb' in out and 'crosses regions' in out
+
+    # Co-located edges stay silent.
+    dag2 = dag_lib.Dag()
+    dag2.add(_task('train', region='us-west1'))
+    dag2.add(_task('eval', ['train'], region='us-west1'))
+    buf2 = io.StringIO()
+    handler2 = logging.StreamHandler(buf2)
+    log.addHandler(handler2)
+    try:
+        optimizer.optimize(dag2, quiet=True)
+    finally:
+        log.removeHandler(handler2)
+    assert 'crosses regions' not in buf2.getvalue()
