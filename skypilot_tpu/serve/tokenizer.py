@@ -62,6 +62,9 @@ class HFTokenizer:
         self.name = os.path.basename(os.path.normpath(path))
         self._auto = None
         self._raw = None
+        # Set once a fold-and-retry succeeds: this template rejects the
+        # system role, so later requests fold up front.
+        self._folds_system = False
         try:
             import transformers
             self._auto = transformers.AutoTokenizer.from_pretrained(path)
@@ -101,17 +104,70 @@ class HFTokenizer:
     def apply_chat_template(self, messages: Sequence[dict]) -> List[int]:
         """Token ids for a chat, ready to generate the assistant turn.
         Uses the checkpoint's own jinja template when it ships one
-        (Llama-3-Instruct etc.); otherwise a generic role-tagged
-        transcript."""
+        (Llama-3-Instruct, Qwen2's ChatML, Gemma's <start_of_turn>
+        form); otherwise a generic role-tagged transcript.
+
+        Templates that REJECT the system role (Gemma raises
+        'System role not supported') get the system content folded into
+        the first user turn and one retry — the convention Gemma chat
+        clients use — so an OpenAI client sending the ubiquitous
+        system+user shape is served through the REAL template rather
+        than 400ing or silently dropping to the generic transcript."""
         if self._auto is not None and getattr(
                 self._auto, 'chat_template', None):
+            msgs = list(messages)
+            if getattr(self, '_folds_system', False):
+                # Known system-rejecting template: fold up front (no
+                # doomed render + retry on every request).
+                msgs = _fold_system_into_user(msgs) or msgs
             try:
                 return list(self._auto.apply_chat_template(
-                    list(messages), add_generation_prompt=True))
+                    msgs, add_generation_prompt=True))
             except Exception as e:  # noqa: BLE001 — template quirk
+                # Retry with folding ONLY for an actual system-role
+                # rejection (Gemma raise_exception()s with a message
+                # naming the system role) — any other template error
+                # must not silently demote the system turn.
+                folded = (_fold_system_into_user(msgs)
+                          if 'system' in str(e).lower() else None)
+                if folded is not None:
+                    try:
+                        ids = list(self._auto.apply_chat_template(
+                            folded, add_generation_prompt=True))
+                        if not getattr(self, '_folds_system', False):
+                            self._folds_system = True
+                            logger.info(
+                                'chat template rejects the system '
+                                'role (%s); folding system content '
+                                'into the first user turn from now '
+                                'on', e)
+                        return ids
+                    except Exception:  # noqa: BLE001 — still broken
+                        pass
                 logger.warning('chat template failed (%s); using '
                                'generic transcript', e)
         return self.encode(generic_chat_text(messages))
+
+
+def _fold_system_into_user(messages: Sequence[dict]):
+    """For templates without a system role: merge ALL leading system
+    messages into the first user turn (keeping the user/assistant
+    alternation such templates also enforce; leaving a second system
+    message in place would render a '<start_of_turn>system' turn the
+    model was never trained on). Returns None when there is nothing to
+    fold."""
+    msgs = [dict(m) for m in messages]
+    system_parts = []
+    while msgs and msgs[0].get('role') == 'system':
+        system_parts.append(msgs.pop(0).get('content', ''))
+    if not system_parts:
+        return None
+    system = '\n\n'.join(system_parts)
+    if msgs and msgs[0].get('role') == 'user':
+        msgs[0]['content'] = f"{system}\n\n{msgs[0].get('content', '')}"
+    else:
+        msgs.insert(0, {'role': 'user', 'content': system})
+    return msgs
 
 
 def generic_chat_text(messages: Sequence[dict]) -> str:
