@@ -277,14 +277,21 @@ def _online_serving(device):
                 return s.getsockname()[1]
 
         def run(name, cfg, batch, n_requests, max_tokens, params=None,
-                quantize=None, kv_quantize=None):
+                quantize=None, kv_quantize=None, prompts=None,
+                buckets=(32,), prefix_cache=0, concurrency=None,
+                max_decode_len=256):
+            # max_decode_len stays 256 for the TRACKED rows (decode
+            # streams the whole [T] cache row per step, so T is part of
+            # the measured config and must not drift across rounds);
+            # only the prefix-reuse rows need a longer row.
             import gc
             eng = engine_lib.Engine(
                 cfg, params=params,
                 engine_cfg=engine_lib.EngineConfig(
-                    batch_size=batch, max_decode_len=256,
-                    prefill_buckets=(32,), quantize=quantize,
-                    kv_quantize=kv_quantize))
+                    batch_size=batch, max_decode_len=max_decode_len,
+                    prefill_buckets=buckets, quantize=quantize,
+                    kv_quantize=kv_quantize,
+                    prefix_cache=prefix_cache))
             port = free_port()
             srv = engine_server.ModelServer.from_engine(
                 eng, port, model_name=name)
@@ -296,15 +303,18 @@ def _online_serving(device):
                     # failed warm-up must not leave this engine's HBM
                     # pinned under the next (8B) run.
                     return {'error': 'server failed to warm up'}
-                prompts = [[1] * 24 for _ in range(n_requests)]
+                if prompts is None:
+                    prompts = [[1] * 24 for _ in range(n_requests)]
                 # Warm the prefill bucket + a couple of decode steps.
                 serving_bench.run_benchmark(
                     '127.0.0.1', port, prompts[:2], max_tokens=4,
                     concurrency=2)
                 report = serving_bench.run_benchmark(
                     '127.0.0.1', port, prompts, max_tokens=max_tokens,
-                    concurrency=min(batch * 2, n_requests))
+                    concurrency=concurrency
+                    or min(batch * 2, len(prompts)))
                 report['model'] = name
+                report['prefix_hits'] = eng.prefix_hits
                 if '8b' in name:
                     report['vs_ref_11.42_req_s'] = round(
                         report['req_per_s'] / 11.42, 2)
@@ -319,6 +329,35 @@ def _online_serving(device):
         out = {}
         out['llama3-1b'] = run('llama3-1b', llama.llama3_1b(), 32,
                                n_requests=100, max_tokens=64)
+        try:
+            # Prefix-KV reuse TTFT row: 48 requests sharing a 384-token
+            # system prefix with unique 16-token tails, prefix cache on
+            # vs off — the chat-workload shape. The metric is
+            # ttft_p50_s: with reuse the per-request prefill drops from
+            # 512-bucket full attention to a 64-bucket suffix extend.
+            shared = [3] * 384
+            pre_prompts = [shared + [100 + i] * 16 for i in range(48)]
+            kw = dict(prompts=pre_prompts, n_requests=48, max_tokens=16,
+                      buckets=(64, 512), concurrency=16,
+                      max_decode_len=512)
+            cold = run('llama3-1b-sharedprefix-off', llama.llama3_1b(),
+                       16, **kw)
+            warm = run('llama3-1b-sharedprefix-on', llama.llama3_1b(),
+                       16, prefix_cache=4, **kw)
+            ratio = None
+            if (isinstance(cold.get('ttft_p50_s'), float)
+                    and isinstance(warm.get('ttft_p50_s'), float)
+                    and cold['ttft_p50_s'] > 0):
+                ratio = round(warm['ttft_p50_s'] / cold['ttft_p50_s'],
+                              2)
+            out['prefix_reuse'] = {
+                'off_ttft_p50_s': cold.get('ttft_p50_s'),
+                'on_ttft_p50_s': warm.get('ttft_p50_s'),
+                'ttft_ratio_on_over_off': ratio,
+                'prefix_hits': warm.get('prefix_hits'),
+            }
+        except Exception as e:  # noqa: BLE001 — optional sub-metric
+            out['prefix_reuse_error'] = str(e)[:160]
         try:
             cfg8 = llama.llama3_8b()
             out['llama3-8b-int8'] = run(

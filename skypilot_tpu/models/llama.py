@@ -347,6 +347,32 @@ def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, s, h, hd)
 
 
+def _extend_attention(q: jax.Array, k_pre: jax.Array, v_pre: jax.Array,
+                      k: jax.Array, v: jax.Array) -> jax.Array:
+    """Prefix-extend attention: suffix queries q [B, S, H, hd] over
+    concat(prefix, suffix) keys — the prefill half of prefix-KV reuse.
+    Every prefix position is a REAL token (the engine slices entries to
+    grid-aligned true lengths), so the mask is: prefix fully visible,
+    suffix causal with its positions offset by the prefix length."""
+    b, s, h, hd = q.shape
+    s_pre = k_pre.shape[1]
+    kv_heads = k.shape[2]
+    group = h // kv_heads
+    kf = jnp.concatenate([k_pre.astype(k.dtype), k], axis=1)
+    vf = jnp.concatenate([v_pre.astype(v.dtype), v], axis=1)
+    qg = q.reshape(b, s, kv_heads, group, hd)
+    scores = jnp.einsum('bqkgh,bskh->bkgqs', qg, kf,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(hd).astype(jnp.float32)
+    t = jnp.arange(s_pre + s)
+    i = jnp.arange(s)
+    mask = (t[None, :] < s_pre) | (t[None, :] - s_pre <= i[:, None])
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bkgqs,bskh->bqkgh', probs.astype(vf.dtype), vf)
+    return out.reshape(b, s, h, hd)
+
+
 def _kernel_compatible(q: jax.Array) -> bool:
     """Flash kernel constraints: lane-width head dim, block-divisible seq."""
     seq, head_dim = q.shape[1], q.shape[3]
@@ -400,13 +426,17 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
-           angles: jax.Array, return_kv: bool = False, cache=None):
+           angles: jax.Array, return_kv: bool = False, cache=None,
+           prefix=None):
     """One transformer block, shared by training forward, prefill and
     cached decode. `cache=(k_cache, v_cache, lengths)` switches attention
     to the KV-cache path (q of length 1 against the full cache row);
-    `return_kv` additionally emits this layer's fresh k/v (prefill)."""
+    `return_kv` additionally emits this layer's fresh k/v (prefill);
+    `prefix=(k_pre, v_pre)` ([B, S_pre, KV, hd] real tokens) switches
+    prefill to the extend path (prefix-KV reuse)."""
     x, kv_out = attention_block(cfg, x, layer_params, angles,
-                                return_kv=return_kv, cache=cache)
+                                return_kv=return_kv, cache=cache,
+                                prefix=prefix)
 
     mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
     gate = _mlp_act(cfg)(quant.qdot(mlp_in, layer_params['w_gate']))
@@ -418,7 +448,7 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
 
 def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
                     angles: jax.Array, return_kv: bool = False,
-                    cache=None):
+                    cache=None, prefix=None):
     """Pre-norm attention sub-block with residual: the piece shared by
     Llama and the MoE models (mixtral swaps only the FFN). Returns
     (x_after_residual, kv_out) with kv semantics as in `_layer`."""
@@ -446,6 +476,13 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
         attn_out = _cached_attention(q, k_cache, v_cache, k, v,
                                      lengths).reshape(b, s, h * hd)
         kv_out = (k, v)
+    elif prefix is not None:
+        # Extend path (prefix-KV reuse): suffix attends over the reused
+        # prefix + itself; emits only the SUFFIX k/v (the engine
+        # concatenates for the cache insert).
+        attn_out = _extend_attention(q, prefix[0], prefix[1], k,
+                                     v).reshape(b, s, h * hd)
+        kv_out = (k, v)
     else:
         attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
         kv_out = (k, v) if return_kv else None
@@ -459,8 +496,14 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
 def forward(params: Params, tokens: jax.Array,
             cfg: LlamaConfig,
             positions: Optional[jax.Array] = None,
-            return_kv: bool = False):
-    """tokens [B, S] int32 -> logits [B, S, V] float32."""
+            return_kv: bool = False,
+            prefix=None):
+    """tokens [B, S] int32 -> logits [B, S, V] float32.
+
+    `prefix={'k': [L, B, S_pre, KV, hd], 'v': ...}` (real tokens only)
+    runs the extend-prefill path: `tokens` are a suffix whose
+    `positions` the caller offsets by S_pre; attention sees
+    prefix + suffix, and the returned kv covers the SUFFIX only."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.arange(s)
@@ -478,14 +521,26 @@ def forward(params: Params, tokens: jax.Array,
 
     kv = None
     if cfg.scan_layers:
-        def scan_body(carry, layer_params):
-            return layer_fn(carry, layer_params, angles)
-        x, kv = jax.lax.scan(scan_body, x, params['layers'])
+        if prefix is not None:
+            def scan_body(carry, xs):
+                layer_params, k_pre, v_pre = xs
+                return layer_fn(carry, layer_params, angles,
+                                prefix=(k_pre, v_pre))
+            x, kv = jax.lax.scan(
+                scan_body, x, (params['layers'], prefix['k'],
+                               prefix['v']))
+        else:
+            def scan_body(carry, layer_params):
+                return layer_fn(carry, layer_params, angles)
+            x, kv = jax.lax.scan(scan_body, x, params['layers'])
     else:
         ks, vs = [], []
         for i in range(cfg.n_layers):
             layer_params = jax.tree.map(lambda p: p[i], params['layers'])
-            x, layer_kv = layer_fn(x, layer_params, angles)
+            layer_prefix = (None if prefix is None else
+                            (prefix['k'][i], prefix['v'][i]))
+            x, layer_kv = layer_fn(x, layer_params, angles,
+                                   prefix=layer_prefix)
             if return_kv:
                 ks.append(layer_kv[0])
                 vs.append(layer_kv[1])
@@ -515,6 +570,10 @@ def forward(params: Params, tokens: jax.Array,
 # skeleton then writes the new token at index lengths[b] with a
 # single-element scatter (decode_tail). Everything is static-shape so
 # the decode step compiles once.
+
+# The serving engine gates prefix-KV reuse on this (the extend path
+# above); model modules without it (mixtral) prefill normally.
+SUPPORTS_PREFIX = True
 
 KV_CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
 KV_LAYER_SPEC = P(('dp', 'fsdp'), None, 'tp', None)   # per-layer slice

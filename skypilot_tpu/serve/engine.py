@@ -79,6 +79,17 @@ class EngineConfig:
     # burst's prefill time — the JetStream-style prefill/decode
     # interleave. 0 = unlimited (drain the waiting queue each step).
     max_admit_per_step: int = 4
+    # Prefix-KV reuse: keep the dense KV of the last N prefilled
+    # prompts; a new prompt sharing a long-enough common token prefix
+    # with any entry prefills only the suffix (shared system prompts /
+    # chat templates hit on every request after the first — the TTFT
+    # win chat workloads leave on the table). Sound because causal
+    # attention makes kv[:c] depend only on tokens[:c]. 0 = off.
+    prefix_cache: int = 0
+    # Reused prefix lengths are quantized DOWN to multiples of this:
+    # one compiled extend program per (grid point, suffix bucket), and
+    # anything shorter than one grid step is not worth reusing.
+    prefix_grid: int = 64
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,6 +228,11 @@ class Engine:
             self._topps = jax.device_put(self._topps, repl)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
+        # Prefix-KV store: prompt token array -> dense kv sliced to the
+        # prompt's true length. Insertion-ordered for LRU eviction.
+        self._prefix_store: 'collections.OrderedDict' = \
+            collections.OrderedDict()
+        self.prefix_hits = 0
 
         def out_s(*specs):
             return None if mesh is None else specs
@@ -232,6 +248,10 @@ class Engine:
             out_shardings=out_s(repl, repl, kv_ns))
         self._prefill_many_jit = jax.jit(
             functools.partial(self._prefill_many_impl, cfg=model_cfg),
+            static_argnames=('sampling_on',),
+            out_shardings=out_s(repl, repl, kv_ns))
+        self._extend_jit = jax.jit(
+            functools.partial(self._extend_impl, cfg=model_cfg),
             static_argnames=('sampling_on',),
             out_shardings=out_s(repl, repl, kv_ns))
         self._insert_jit = jax.jit(
@@ -403,6 +423,84 @@ class Engine:
         topps = topps.at[slot].set(topp)
         return new_cache, lengths, tokens, temps, topks, topps
 
+    def _extend_impl(self, params, prefix_k, prefix_v, tokens, true_len,
+                     key, temp, topk, topp, cfg, sampling_on):
+        """Extend prefill (prefix-KV reuse): `tokens` [1, S_bucket] is
+        the SUFFIX of a prompt whose first P tokens' kv ([L, 1, P, KV,
+        hd], all real tokens) is reused; RoPE positions are offset by
+        P. Returns the FULL prompt kv (prefix + suffix) ready for the
+        unchanged insert path."""
+        s = tokens.shape[1]
+        p = prefix_k.shape[2]
+        logits, kv = self.model.forward(
+            params, tokens, cfg, positions=p + jnp.arange(s),
+            return_kv=True, prefix={'k': prefix_k, 'v': prefix_v})
+        last = logits[0, true_len - 1]
+        toks, logps = self._sample(last[None], key, temp[None],
+                                   topk[None], topp[None], sampling_on)
+        full = {'k': jnp.concatenate([prefix_k, kv['k']], axis=2),
+                'v': jnp.concatenate([prefix_v, kv['v']], axis=2)}
+        return toks[0], logps[0], full
+
+    # -- prefix-KV store ----------------------------------------------- #
+
+    def _prefix_enabled(self) -> bool:
+        return (self.cfg.prefix_cache > 0
+                and getattr(self.model, 'SUPPORTS_PREFIX', False))
+
+    def _find_prefix(self, prompt) -> Optional[Tuple[int, bytes]]:
+        """Longest grid-aligned common token prefix between `prompt`
+        and any stored entry (leaving at least one suffix token).
+        Host-side only — no device work. Returns (length, store key)."""
+        if not self._prefix_enabled() or not self._prefix_store:
+            return None
+        pa = np.asarray(prompt, np.int32)
+        grid = self.cfg.prefix_grid
+        best, best_key = 0, None
+        for key, (toks, _kv) in self._prefix_store.items():
+            m = min(len(toks), len(pa) - 1)
+            if m < grid:
+                continue
+            eq = toks[:m] == pa[:m]
+            c = m if eq.all() else int(np.argmin(eq))
+            if c > best:
+                best, best_key = c, key
+        q = (best // grid) * grid
+        if q < grid:
+            return None
+        return q, best_key
+
+    def _take_prefix(self, q: int, key: bytes):
+        """Slice the stored kv to the grid-aligned reuse length (every
+        kept position is a real token — the extend mask depends on it)
+        and LRU-touch the entry."""
+        _toks, kv = self._prefix_store[key]
+        self._prefix_store.move_to_end(key)
+        self.prefix_hits += 1
+        return {'k': kv['k'][:, :, :q], 'v': kv['v'][:, :, :q]}
+
+    def _store_prefix(self, prompt, kv, n: int) -> None:
+        """Remember this prompt's dense kv (sliced to its true length)
+        for future common-prefix reuse; sound because causal attention
+        makes kv[:c] depend only on tokens[:c]. LRU-bounded — entries
+        hold device memory ([L, 1, n, KV, hd] bf16 each), so
+        prefix_cache should stay small."""
+        if not self._prefix_enabled():
+            return
+        arr = np.asarray(prompt, np.int32)
+        key = arr.tobytes()
+        self._prefix_store[key] = (
+            arr, {'k': kv['k'][:, :, :n], 'v': kv['v'][:, :, :n]})
+        self._prefix_store.move_to_end(key)
+        while len(self._prefix_store) > self.cfg.prefix_cache:
+            self._prefix_store.popitem(last=False)
+
+    def warm_prefix(self, tokens) -> None:
+        """Precompute + store a shared prefix's KV (e.g. the rendered
+        system prompt) so even the FIRST real request reuses it.
+        Requires prefix_cache > 0."""
+        self.prefill(list(tokens))
+
     def _prefill_many_impl(self, params, tokens, true_lens, key,
                            temps, topks, topps, cfg, sampling_on):
         """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
@@ -495,20 +593,53 @@ class Engine:
         self.validate_sampling(sampling)
         return sampling
 
+    def _prefill_dispatch(self, prompt: Sequence[int],
+                          sp: SamplingParams, found=None):
+        """Dispatch a single-prompt prefill WITHOUT host reads; returns
+        device (token, logprob, kv). Routes through the extend path
+        when `found` (or a fresh lookup) names a stored prefix."""
+        self._key, sub = jax.random.split(self._key)
+        if found is None:
+            found = self._find_prefix(prompt)
+        if found is not None:
+            # The concatenated (q + suffix_bucket) kv must still fit a
+            # cache row; bucket rounding can overshoot near
+            # max_decode_len, where reuse is declined.
+            q, key = found
+            bucket = self._bucket(len(prompt) - q)
+            if q + bucket > self.cfg.max_decode_len - 1:
+                found = None
+        if found is not None:
+            pre = self._take_prefix(q, key)
+            suffix = list(prompt[q:])
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(suffix)] = suffix
+            tok, logp, kv = self._extend_jit(
+                self.params, pre['k'], pre['v'], jnp.asarray(padded),
+                len(suffix), sub, jnp.float32(sp.temperature),
+                jnp.int32(sp.top_k), jnp.float32(sp.top_p),
+                sampling_on=sp.temperature > 0)
+        else:
+            bucket = self._bucket(len(prompt))
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :len(prompt)] = prompt
+            tok, logp, kv = self._prefill_jit(
+                self.params, jnp.asarray(padded), len(prompt), sub,
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p), sampling_on=sp.temperature > 0)
+        self._store_prefix(prompt, kv, len(prompt))
+        return tok, logp, kv
+
     def prefill(self, prompt: Sequence[int],
                 sampling: Optional[SamplingParams] = None
                 ) -> Tuple[int, float, Any]:
-        """Returns (first generated token, its logprob, prefix kv)."""
+        """Returns (first generated token, its logprob, prompt kv).
+        With prefix_cache on, a prompt sharing a grid-aligned common
+        prefix with a recent prompt prefills only the suffix (extend
+        path) — the returned kv still covers the whole prompt."""
         self._validate(prompt)
         sp = self._sampling_or_default(sampling)
-        bucket = self._bucket(len(prompt))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(prompt)] = prompt
-        self._key, sub = jax.random.split(self._key)
-        tok, logp, kv = self._prefill_jit(
-            self.params, jnp.asarray(padded), len(prompt), sub,
-            jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-            jnp.float32(sp.top_p), sampling_on=sp.temperature > 0)
+        tok, logp, kv = self._prefill_dispatch(prompt, sp)
         return int(tok), float(logp), kv
 
     def insert(self, prefix_kv: Any, slot: int, length: int,
@@ -547,7 +678,21 @@ class Engine:
             norm.append((slot_id, prompt, sp))
         out: Dict[int, int] = {}
         by_bucket: Dict[int, List[Tuple]] = {}
+        # (slot_id, device token, device logprob): prefix-hit dispatches
+        # whose host reads are deferred with the batched chunks'.
+        pending_singles: List[Tuple[int, Any, Any]] = []
         for slot_id, prompt, sp in norm:
+            found = self._find_prefix(prompt)
+            if found is not None:
+                # Prefix-KV hit: the extend path (suffix-only prefill)
+                # beats riding a full batched prefill. The match is
+                # passed through so dispatch does not re-scan the
+                # store, and reads are deferred like the chunks'.
+                tok, logp, kv = self._prefill_dispatch(prompt, sp,
+                                                       found=found)
+                self.insert(kv, slot_id, len(prompt), tok, sampling=sp)
+                pending_singles.append((slot_id, tok, logp))
+                continue
             by_bucket.setdefault(self._bucket(len(prompt)), []).append(
                 (slot_id, prompt, sp))
         pending_gets: List[Tuple[List[Tuple], jax.Array]] = []
@@ -592,6 +737,13 @@ class Engine:
                     jnp.asarray(true_lens), self._lengths,
                     self._tokens, toks, self._temps, self._topks,
                     self._topps, temps, topks, topps)
+                if self._prefix_enabled():
+                    # Batched prefills seed the store too — a burst's
+                    # first wave makes every later request a hit.
+                    for j, (_sid, p, _sp2) in enumerate(chunk):
+                        self._store_prefix(
+                            p, {'k': kv['k'][:, j:j + 1],
+                                'v': kv['v'][:, j:j + 1]}, len(p))
                 # Defer the device->host read: dispatching the next
                 # chunk must not wait on this one retiring.
                 pending_gets.append((chunk, toks, logps))
@@ -600,6 +752,9 @@ class Engine:
             logps_np = np.asarray(jax.device_get(logps))
             for j, (sid, _p, _sp) in enumerate(chunk):
                 out[sid] = (int(toks_np[j]), float(logps_np[j]))
+        for sid, tok, logp in pending_singles:
+            out[sid] = (int(jax.device_get(tok)),
+                        float(jax.device_get(logp)))
         return out
 
     def decode_dispatch(self):

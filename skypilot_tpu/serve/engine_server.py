@@ -120,7 +120,8 @@ class ModelServer:
                  tp: int = 1,
                  hf_model: Optional[str] = None,
                  kv_quantize: Optional[str] = None,
-                 ckpt: Optional[str] = None):
+                 ckpt: Optional[str] = None,
+                 prefix_cache: int = 0):
         params = None
         eos_id = EOS_ID
 
@@ -180,7 +181,8 @@ class ModelServer:
             engine_cfg=engine_lib.EngineConfig(
                 batch_size=batch_size, max_decode_len=max_decode_len,
                 eos_id=eos_id, temperature=temperature,
-                quantize=quantize, kv_quantize=kv_quantize))
+                quantize=quantize, kv_quantize=kv_quantize,
+                prefix_cache=prefix_cache))
         self.port = port
         self.ready = threading.Event()
         self.request_queue: queue.Queue = queue.Queue()
@@ -778,12 +780,19 @@ def main() -> None:
                              '(models/native_ckpt.py — e.g. '
                              'finetune_lora.py --merge-out output); '
                              'overrides --model/--hf-model')
+    parser.add_argument('--prefix-cache', type=int, default=0,
+                        help='prefix-KV reuse: keep the KV of this '
+                             'many recent prompts; requests sharing a '
+                             'common prefix (shared system prompts) '
+                             'prefill only the suffix (cuts TTFT). '
+                             '0 disables.')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
                 args.quantize, args.tp, args.hf_model,
-                args.kv_quantize, ckpt=args.ckpt).serve_forever()
+                args.kv_quantize, ckpt=args.ckpt,
+                prefix_cache=args.prefix_cache).serve_forever()
 
 
 if __name__ == '__main__':

@@ -67,6 +67,7 @@ def test_image_id_helpers():
     assert res.to_yaml_config()['image_id'] == 'docker:my/img:tag'
 
 
+@pytest.mark.soak
 def test_docker_launch_runs_inside_container(docker_bin):
     """The job runs in the container (its $HOME is the container dir,
     not the host dir), the agent runtime lives in-container, and logs
@@ -101,6 +102,7 @@ def test_docker_launch_runs_inside_container(docker_bin):
     assert global_user_state.get_cluster('dk') is None
 
 
+@pytest.mark.soak
 def test_docker_multihost_env_contract(docker_bin):
     """2-host slice: every host gets its own container; the gang env
     contract holds inside them."""

@@ -58,6 +58,7 @@ def _wait_job(cluster, job_id, timeout=90):
     raise TimeoutError(f'job {job_id} still {status}')
 
 
+@pytest.mark.soak
 def test_gke_launch_exec_logs_down(gke):
     """Single-host slice: launch runs the job through kubectl exec,
     logs stream back, exec reuses the live cluster, down deletes the
@@ -86,6 +87,7 @@ def test_gke_launch_exec_logs_down(gke):
     assert 'g1' not in gke.services
 
 
+@pytest.mark.soak
 def test_gke_multihost_env_contract(gke):
     """2 slices x 2 hosts (tpu-v5e-16): the gang executor reaches every
     -n<node>-h<host> pod over kubectl and the rank/coordinator/megascale
@@ -116,6 +118,7 @@ def test_gke_multihost_env_contract(gke):
     core.down('gpod')
 
 
+@pytest.mark.soak
 def test_gke_setup_and_failure_propagation(gke):
     """setup runs before run; a failing run marks FAILED."""
     job_id, _ = sky.launch(

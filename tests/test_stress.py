@@ -16,6 +16,8 @@ import urllib.request
 import pytest
 import yaml
 
+pytestmark = pytest.mark.soak
+
 import skypilot_tpu as sky
 from skypilot_tpu import global_user_state
 from skypilot_tpu.jobs import core as jobs_core

@@ -82,6 +82,7 @@ def test_mesh_validation():
         mesh_lib.default_mesh_shape(8, tp=3)
 
 
+@pytest.mark.soak
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
