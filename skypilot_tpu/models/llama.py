@@ -88,6 +88,12 @@ class LlamaConfig:
     # accounting counts the matrix once, and the engine keeps ONE
     # device copy.
     tied_embeddings: bool = False
+    # int8-weight matmuls through the pallas in-kernel-dequant kernel
+    # (ops/int8_matmul.py): 'tpu' on-chip, 'interpret' for CPU tests,
+    # None = XLA path. The serving engine sets this on single-device
+    # TPU (a pallas_call is opaque to GSPMD, so mesh serving keeps the
+    # XLA path). Training never sets it.
+    int8_kernel: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -439,9 +445,12 @@ def _layer(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
                                 prefix=prefix)
 
     mlp_in = rms_norm(x, layer_params['ln_mlp'], cfg.norm_eps)
-    gate = _mlp_act(cfg)(quant.qdot(mlp_in, layer_params['w_gate']))
-    up = quant.qdot(mlp_in, layer_params['w_up'])
-    x = x + quant.qdot(gate * up, layer_params['w_down'])
+    kern = getattr(cfg, 'int8_kernel', None)
+    gate = _mlp_act(cfg)(quant.qdot(mlp_in, layer_params['w_gate'],
+                                    kernel=kern))
+    up = quant.qdot(mlp_in, layer_params['w_up'], kernel=kern)
+    x = x + quant.qdot(gate * up, layer_params['w_down'],
+                       kernel=kern)
     x = _shard(x, ACT_SPEC)
     return x, kv_out
 
@@ -456,9 +465,10 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     h, kv, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     attn_in = rms_norm(x, layer_params['ln_attn'], cfg.norm_eps)
-    q = quant.qdot(attn_in, layer_params['wq'])
-    k = quant.qdot(attn_in, layer_params['wk'])
-    v = quant.qdot(attn_in, layer_params['wv'])
+    kern = getattr(cfg, 'int8_kernel', None)
+    q = quant.qdot(attn_in, layer_params['wq'], kernel=kern)
+    k = quant.qdot(attn_in, layer_params['wk'], kernel=kern)
+    v = quant.qdot(attn_in, layer_params['wv'], kernel=kern)
     if 'bq' in layer_params:      # Qwen2-style q/k/v biases
         q = q + layer_params['bq']
         k = k + layer_params['bk']
@@ -486,7 +496,7 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     else:
         attn_out = attention(q, k, v, cfg).reshape(b, s, h * hd)
         kv_out = (k, v) if return_kv else None
-    proj = quant.qdot(attn_out, layer_params['wo'])
+    proj = quant.qdot(attn_out, layer_params['wo'], kernel=kern)
     if 'bo' in layer_params:      # HF Llama attention_bias o_proj bias
         proj = proj + layer_params['bo']
     x = x + proj
@@ -549,6 +559,7 @@ def forward(params: Params, tokens: jax.Array,
 
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
+                           kernel=getattr(cfg, 'int8_kernel', None),
                            preferred_element_type=jnp.float32)
     logits = _shard(logits, LOGITS_SPEC)
     if return_kv:
@@ -729,6 +740,7 @@ def decode_tail(params: Params, cache: Params, lengths: jax.Array,
                                         i, k_l, v_l)
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
+                           kernel=getattr(cfg, 'int8_kernel', None),
                            preferred_element_type=jnp.float32)
     return logits[:, 0], {'k': new_k, 'v': new_v}
 

@@ -1,0 +1,159 @@
+"""Pallas TPU kernels: int8 weight-only matmul with IN-KERNEL dequant.
+
+Decode is HBM-bandwidth-bound: every step streams the full weight set,
+so int8 weights should halve step time. The XLA path
+(`x @ q.astype(bf16) * scale`, ops/quant.py) only delivers that if the
+convert fuses into the matmul's read loop; when XLA instead
+materializes a bf16 copy, the weight bytes triple (int8 read + bf16
+write + bf16 read) — which matches the measured int8 decode sitting at
+~35% of its roofline (MEASUREMENTS_r04.md). These kernels make the
+fusion structural instead of hoping: int8 blocks stream HBM→VMEM, the
+convert happens in VMEM on the way into the MXU, the f32 accumulator
+lives in VMEM scratch, and the per-output-channel scale multiplies the
+block output once at the last reduction step.
+
+Two layouts, matching models/llama.py's quantized weights:
+  * `int8_matmul`   — x [R, D] @ q [D, F], scale [F]   (layer weights)
+  * `int8_matmul_t` — x [R, D] @ q [V, D]^T, scale [V] (lm_head/embed:
+    contraction on the weight's LAST axis)
+
+Single-device only: under a tp/ep mesh the engine keeps the XLA path
+(a pallas_call is opaque to GSPMD partitioning). The engine opts in via
+LlamaConfig.int8_kernel; tests run the same kernels with
+interpret=True on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Candidate block edges, largest first; a dim must be divisible by one
+# of these (all weight dims in the Llama lineage are multiples of 128).
+_BLOCK_CANDIDATES_D = (1024, 512, 256, 128)
+_BLOCK_CANDIDATES_F = (512, 256, 128)
+
+
+def _pick_block(dim: int, candidates) -> int:
+    for b in candidates:
+        if dim % b == 0:
+            return b
+    return 0
+
+
+def _matmul_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nd: int,
+                   transpose: bool):
+    """One (r, f, d) grid step: acc += x_blk @ dequant(q_blk). The d
+    axis iterates fastest, so acc_ref accumulates the full contraction
+    for one output block before o_ref is written."""
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    w = q_ref[...].astype(jnp.bfloat16)
+    if transpose:                       # q block [F_blk, D_blk]
+        acc_ref[...] += jax.lax.dot_general(
+            x_ref[...], w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:                               # q block [D_blk, F_blk]
+        acc_ref[...] += jnp.dot(x_ref[...], w,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == nd - 1)
+    def _done():
+        # Mirror the XLA path's rounding points exactly
+        # ((x @ q.astype(bf16)) * scale.astype(bf16)): round the f32
+        # accumulator to the output dtype FIRST, then scale in that
+        # dtype — otherwise near-tie logits can argmax differently
+        # between the two int8 paths.
+        if o_ref.dtype == jnp.float32:
+            o_ref[...] = acc_ref[...] * s_ref[...].astype(jnp.float32)
+        else:
+            o_ref[...] = (acc_ref[...].astype(o_ref.dtype)
+                          * s_ref[...].astype(o_ref.dtype))
+
+
+# Row-block cap: rows above this tile over the grid's leading axis so a
+# batched long-bucket prefill (rows = N x S_bucket, up to 16k) cannot
+# blow the ~16 MB VMEM budget with a monolithic x block + accumulator.
+_MAX_BLOCK_R = 512
+
+
+def _call(x, q, scale, *, transpose: bool, interpret: bool,
+          out_dtype=None):
+    rows, d = x.shape
+    if transpose:
+        f, d2 = q.shape
+    else:
+        d2, f = q.shape
+    assert d == d2, (x.shape, q.shape)
+    block_d = _pick_block(d, _BLOCK_CANDIDATES_D)
+    block_f = _pick_block(f, _BLOCK_CANDIDATES_F)
+    if not block_d or not block_f:
+        return None
+    if rows <= _MAX_BLOCK_R:
+        block_r = rows
+    else:
+        block_r = _pick_block(rows, (_MAX_BLOCK_R, 256, 128))
+        if not block_r:
+            return None                 # odd row count: XLA path
+    nr = rows // block_r
+    nd, nf = d // block_d, f // block_f
+    if transpose:
+        q_spec = pl.BlockSpec((block_f, block_d),
+                              lambda ri, fi, di: (fi, di))
+    else:
+        q_spec = pl.BlockSpec((block_d, block_f),
+                              lambda ri, fi, di: (di, fi))
+    out = pl.pallas_call(
+        functools.partial(_matmul_kernel, nd=nd, transpose=transpose),
+        grid=(nr, nf, nd),
+        in_specs=[
+            pl.BlockSpec((block_r, block_d),
+                         lambda ri, fi, di: (ri, di)),
+            q_spec,
+            pl.BlockSpec((1, block_f), lambda ri, fi, di: (0, fi)),
+        ],
+        out_specs=pl.BlockSpec((block_r, block_f),
+                               lambda ri, fi, di: (ri, fi)),
+        out_shape=jax.ShapeDtypeStruct((rows, f), out_dtype or x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_r, block_f), jnp.float32)],
+        interpret=interpret,
+    )(x, q, scale.reshape(1, f))
+    return out
+
+
+def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
+                interpret: bool = False):
+    """x [..., D] bf16 @ q [D, F] int8 with scale [F]; returns
+    [..., F] in x.dtype, or None when the shapes don't block-tile
+    (caller falls back to the XLA path)."""
+    lead = x.shape[:-1]
+    rows = 1
+    for n in lead:
+        rows *= n
+    x2 = x.reshape(rows, x.shape[-1])
+    out = _call(x2, q, scale, transpose=False, interpret=interpret)
+    if out is None:
+        return None
+    return out.reshape(*lead, q.shape[1])
+
+
+def int8_matmul_t(x: jax.Array, q: jax.Array, scale: jax.Array,
+                  interpret: bool = False, out_dtype=None):
+    """x [..., D] bf16 contracted with q [V, D] int8 on D (the lm_head
+    layout), scale [V]; returns [..., V] (f32 for logits via
+    out_dtype), or None when not tileable."""
+    lead = x.shape[:-1]
+    rows = 1
+    for n in lead:
+        rows *= n
+    x2 = x.reshape(rows, x.shape[-1])
+    out = _call(x2, q, scale, transpose=True, interpret=interpret,
+                out_dtype=out_dtype)
+    if out is None:
+        return None
+    return out.reshape(*lead, q.shape[0])

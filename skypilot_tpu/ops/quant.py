@@ -90,24 +90,47 @@ class LoraWeight:
         return cls(children[0], children[1], children[2], aux)
 
 
-def qdot(x: jax.Array, w: Any) -> jax.Array:
+def qdot(x: jax.Array, w: Any, kernel: Any = None) -> jax.Array:
     """x [..., D] @ w [D, F] where w is dense, a QTensor with per-[F]
-    scale, or a LoraWeight over either."""
+    scale, or a LoraWeight over either.
+
+    `kernel` ('tpu' | 'interpret' | None) routes QTensor matmuls
+    through the pallas int8 kernel (ops/int8_matmul.py) whose dequant
+    is structurally fused — serving sets it on single-device TPU,
+    where XLA's convert-into-dot fusion is otherwise a gamble the
+    decode roofline loses. Falls back to the XLA path whenever the
+    shapes don't tile."""
     if isinstance(w, LoraWeight):
         delta = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
-        return qdot(x, w.base) + delta * w.scale
+        return qdot(x, w.base, kernel=kernel) + delta * w.scale
     if isinstance(w, QTensor):
+        if kernel is not None and w.q.ndim == 2:
+            from skypilot_tpu.ops import int8_matmul
+            out = int8_matmul.int8_matmul(
+                x, w.q, w.scale, interpret=(kernel == 'interpret'))
+            if out is not None:
+                return out
         return (x @ w.q.astype(x.dtype)) * w.scale.astype(x.dtype)
     return x @ w
 
 
 def qeinsum(spec: str, x: jax.Array, w: Any, scale_insert_axes=None,
-            **kwargs) -> jax.Array:
+            kernel: Any = None, **kwargs) -> jax.Array:
     """einsum where the weight operand may be a QTensor. The scale
     multiplies the OUTPUT; when the weight's kept axes are not the
     output's trailing axes, `scale_insert_axes` expand_dims the scale
-    into broadcast position."""
+    into broadcast position. `kernel` as in qdot — honored for the
+    logits contraction ('bsd,vd->bsv', the largest single weight
+    read of a decode step)."""
     if isinstance(w, QTensor):
+        if (kernel is not None and spec == 'bsd,vd->bsv'
+                and w.q.ndim == 2):
+            from skypilot_tpu.ops import int8_matmul
+            out = int8_matmul.int8_matmul_t(
+                x, w.q, w.scale, interpret=(kernel == 'interpret'),
+                out_dtype=kwargs.get('preferred_element_type'))
+            if out is not None:
+                return out
         out = jnp.einsum(spec, x, w.q.astype(x.dtype), **kwargs)
         scale = w.scale.astype(out.dtype)
         if scale_insert_axes is not None:

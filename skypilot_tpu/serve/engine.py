@@ -25,6 +25,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import os
 import queue
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -150,6 +151,10 @@ class Engine:
         self._buckets = tuple(sorted(
             {min(b, self.cfg.max_decode_len - 1)
              for b in self.cfg.prefill_buckets}))
+        # Whether the CALLER shipped params (bench hands over a
+        # pre-quantized int8 tree) — read before the default init below
+        # would make `params is not None` vacuously true.
+        caller_params = params is not None
         if params is None:
             params = self.model.init_params(jax.random.PRNGKey(seed),
                                             model_cfg)
@@ -158,6 +163,25 @@ class Engine:
                 raise ValueError(
                     f'unsupported {field} mode '
                     f'{getattr(self.cfg, field)!r} (only \'int8\')')
+        # int8 matmuls via the pallas in-kernel-dequant kernel on
+        # single-device TPU (ops/int8_matmul.py — XLA's convert-into-dot
+        # fusion is otherwise a gamble the decode roofline loses); a
+        # tp/ep mesh keeps the XLA path (pallas is opaque to GSPMD).
+        # SKYT_INT8_KERNEL=0 disables; =interpret forces the kernel's
+        # CPU interpreter (tests).
+        kernel_env = os.environ.get('SKYT_INT8_KERNEL', '')
+        if (hasattr(model_cfg, 'int8_kernel')
+                and model_cfg.int8_kernel is None
+                and kernel_env != '0'
+                and mesh is None
+                and (self.cfg.quantize is not None or caller_params)):
+            if kernel_env == 'interpret':
+                model_cfg = dataclasses.replace(model_cfg,
+                                                int8_kernel='interpret')
+            elif jax.default_backend() == 'tpu':
+                model_cfg = dataclasses.replace(model_cfg,
+                                                int8_kernel='tpu')
+            self.model_cfg = model_cfg
         kv_q = self.cfg.kv_quantize is not None
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
         cache = self.model.init_kv_cache(model_cfg, b, t, quantized=kv_q)
@@ -497,8 +521,13 @@ class Engine:
 
     def warm_prefix(self, tokens) -> None:
         """Precompute + store a shared prefix's KV (e.g. the rendered
-        system prompt) so even the FIRST real request reuses it.
-        Requires prefix_cache > 0."""
+        system prompt) so even the FIRST real request reuses it."""
+        if not self._prefix_enabled():
+            # A silent full prefill that stores nothing would look
+            # exactly like the feature not working.
+            raise ValueError(
+                'warm_prefix requires EngineConfig.prefix_cache > 0 '
+                '(and a model with prefix support)')
         self.prefill(list(tokens))
 
     def _prefill_many_impl(self, params, tokens, true_lens, key,

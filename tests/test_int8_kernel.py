@@ -1,0 +1,121 @@
+"""Pallas int8 in-kernel-dequant matmul (ops/int8_matmul.py).
+
+The XLA weight-only path relies on XLA fusing the int8->bf16 convert
+into the matmul's read loop; the kernel makes the fusion structural.
+These tests pin numerical agreement with the XLA path (interpret mode
+on the CPU mesh) at the op level and through the full engine, plus the
+fallback behavior for non-tileable shapes.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import int8_matmul as km
+from skypilot_tpu.ops import quant
+from skypilot_tpu.serve import engine as engine_lib
+
+
+def test_qdot_kernel_matches_xla_path():
+    w = jax.random.normal(jax.random.PRNGKey(0), (512, 384), jnp.float32)
+    qt = quant.quantize(w, reduce_axes=(-2,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, 512)
+                          ).astype(jnp.bfloat16)
+    ref = np.asarray((x @ qt.q.astype(x.dtype))
+                     * qt.scale.astype(x.dtype), np.float32)
+    out = np.asarray(km.int8_matmul(x, qt.q, qt.scale, interpret=True),
+                     np.float32)
+    # Both paths accumulate the same int8 dot; differences are bf16
+    # output rounding (kernel applies the scale in f32 — at least as
+    # accurate as the XLA path's bf16 scale multiply).
+    np.testing.assert_allclose(out, ref, rtol=0.02, atol=0.5)
+
+
+def test_lm_head_kernel_matches_xla_path_fp32():
+    w = jax.random.normal(jax.random.PRNGKey(0), (1024, 512), jnp.float32)
+    qt = quant.quantize(w, reduce_axes=(-1,))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 512)
+                          ).astype(jnp.bfloat16)
+    ref = np.asarray(
+        jnp.einsum('bsd,vd->bsv', x, qt.q.astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+        * qt.scale.astype(jnp.float32))
+    out = np.asarray(km.int8_matmul_t(x, qt.q, qt.scale, interpret=True,
+                                      out_dtype=jnp.float32))
+    assert out.dtype == np.float32
+    np.testing.assert_allclose(out, ref, rtol=0.02, atol=0.5)
+
+
+def test_non_tileable_returns_none():
+    qt = quant.quantize(
+        jax.random.normal(jax.random.PRNGKey(0), (100, 384)),
+        reduce_axes=(-2,))
+    x = jnp.ones((4, 100), jnp.bfloat16)
+    assert km.int8_matmul(x, qt.q, qt.scale, interpret=True) is None
+
+
+def test_qdot_routes_through_kernel_and_falls_back():
+    """quant.qdot(kernel=...) uses the pallas path for tileable shapes
+    and silently falls back otherwise — same numbers either way."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (256, 128))
+    qt = quant.quantize(w, reduce_axes=(-2,))
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 256)
+                          ).astype(jnp.bfloat16)
+    a = np.asarray(quant.qdot(x, qt, kernel='interpret'), np.float32)
+    b = np.asarray(quant.qdot(x, qt), np.float32)
+    np.testing.assert_allclose(a, b, rtol=0.02, atol=0.5)
+    # Non-tileable contraction dim: must not crash, must match.
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (100, 128))
+    qt2 = quant.quantize(w2, reduce_axes=(-2,))
+    x2 = jax.random.normal(jax.random.PRNGKey(3), (4, 100)
+                           ).astype(jnp.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(quant.qdot(x2, qt2, kernel='interpret'), np.float32),
+        np.asarray(quant.qdot(x2, qt2), np.float32), rtol=0.02,
+        atol=0.5)
+
+
+def test_engine_generations_match_with_kernel(monkeypatch):
+    """Full engine on the kernel path (SKYT_INT8_KERNEL=interpret) must
+    produce the same greedy generations as the XLA int8 path."""
+    cfg = llama.llama_tiny()
+    prompts = [[5, 9, 23, 41], [7, 11]]
+
+    monkeypatch.setenv('SKYT_INT8_KERNEL', '0')
+    xla_eng = engine_lib.Engine(
+        cfg, seed=3, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(8,),
+            eos_id=-1, quantize='int8'))
+    assert xla_eng.model_cfg.int8_kernel is None
+    xla_out = xla_eng.generate_batch(prompts, max_new_tokens=8)
+
+    monkeypatch.setenv('SKYT_INT8_KERNEL', 'interpret')
+    k_eng = engine_lib.Engine(
+        cfg, seed=3, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=64, prefill_buckets=(8,),
+            eos_id=-1, quantize='int8'))
+    assert k_eng.model_cfg.int8_kernel == 'interpret'
+    k_out = k_eng.generate_batch(prompts, max_new_tokens=8)
+    assert k_out == xla_out
+
+
+def test_mesh_engine_never_uses_kernel(monkeypatch):
+    """Under a tp mesh the engine must keep the XLA path (pallas is
+    opaque to GSPMD) even when the env asks for the kernel."""
+    monkeypatch.setenv('SKYT_INT8_KERNEL', 'interpret')
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    if jax.device_count() < 2:
+        pytest.skip('needs the virtual 8-device mesh')
+    tp_mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=2),
+                                 devices=jax.devices()[:2])
+    eng = engine_lib.Engine(
+        llama.llama_tiny(), mesh=tp_mesh,
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=32, prefill_buckets=(8,),
+            quantize='int8'))
+    assert eng.model_cfg.int8_kernel is None
+    [out] = eng.generate_batch([[5, 9, 23]], max_new_tokens=4)
+    assert len(out) == 4

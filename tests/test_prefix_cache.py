@@ -94,6 +94,12 @@ def test_extend_with_int8_kv_cache():
     assert out2[0] == cold_out[1]
 
 
+def test_warm_prefix_raises_when_disabled():
+    eng = _engine(prefix_cache=0)
+    with pytest.raises(ValueError, match='prefix_cache'):
+        eng.warm_prefix(SYSTEM)
+
+
 def test_warm_prefix_makes_first_request_hit():
     eng = _engine(prefix_cache=4)
     eng.warm_prefix(SYSTEM)
