@@ -279,7 +279,7 @@ def _online_serving(device):
         def run(name, cfg, batch, n_requests, max_tokens, params=None,
                 quantize=None, kv_quantize=None, prompts=None,
                 buckets=(32,), prefix_cache=0, concurrency=None,
-                max_decode_len=256):
+                max_decode_len=256, online_decode_chunk=1):
             # max_decode_len stays 256 for the TRACKED rows (decode
             # streams the whole [T] cache row per step, so T is part of
             # the measured config and must not drift across rounds);
@@ -291,7 +291,8 @@ def _online_serving(device):
                     batch_size=batch, max_decode_len=max_decode_len,
                     prefill_buckets=buckets, quantize=quantize,
                     kv_quantize=kv_quantize,
-                    prefix_cache=prefix_cache))
+                    prefix_cache=prefix_cache,
+                    online_decode_chunk=online_decode_chunk))
             port = free_port()
             srv = engine_server.ModelServer.from_engine(
                 eng, port, model_name=name)
@@ -364,6 +365,13 @@ def _online_serving(device):
                 'llama3-8b-int8', cfg8, 24, n_requests=48,
                 max_tokens=64, params=_init_int8_on_device(cfg8),
                 kv_quantize='int8')
+            # Same workload, one host sync per 4 tokens: quantifies how
+            # much of the online/offline gap is per-token host RTT
+            # (through a remote relay this is the whole story).
+            out['llama3-8b-int8-chunk4'] = run(
+                'llama3-8b-int8-chunk4', cfg8, 24, n_requests=48,
+                max_tokens=64, params=_init_int8_on_device(cfg8),
+                kv_quantize='int8', online_decode_chunk=4)
         except Exception as e:  # noqa: BLE001 — optional sub-metric
             out['8b_error'] = str(e)[:160]
         return out

@@ -80,6 +80,14 @@ class EngineConfig:
     # burst's prefill time — the JetStream-style prefill/decode
     # interleave. 0 = unlimited (drain the waiting queue each step).
     max_admit_per_step: int = 4
+    # Online multi-step decode (vLLM multi-step analog): the serving
+    # loop fuses this many decode steps per dispatch and pays ONE host
+    # round trip per k tokens per batch. 1 = per-token streaming
+    # (lowest latency); raise it when the path to the device is a
+    # high-RTT relay, where per-token syncs cap throughput at
+    # batch/RTT regardless of device speed. Tokens stream in bursts of
+    # k; up to k-1 wasted slot-steps per finishing stream.
+    online_decode_chunk: int = 1
     # Prefix-KV reuse: keep the dense KV of the last N prefilled
     # prompts; a new prompt sharing a long-enough common token prefix
     # with any entry prefills only the suffix (shared system prompts /
@@ -809,21 +817,31 @@ class Engine:
         toks_np, logps_np = jax.device_get(self.decode_dispatch())
         return np.asarray(toks_np), np.asarray(logps_np)
 
+    def decode_many_dispatch(self, k: int):
+        """Dispatch k fused decode steps without reading back: returns
+        ([k, B] tokens, [k, B] logprobs) device arrays. k=1 reuses the
+        single-step program and returns its 1-D handles untouched
+        (callers normalize host-side — no extra device ops on the
+        per-token latency path)."""
+        if k <= 1:
+            return self.decode_dispatch()
+        self._key, sub = jax.random.split(self._key)
+        toks, logps, self._cache, self._lengths, self._tokens = \
+            self._decode_many_jit(self.params, self._cache,
+                                  self._lengths, self._tokens, sub,
+                                  self._temps, self._topks, self._topps,
+                                  k=k, sampling_on=bool(
+                                      (self._host_temps > 0).any()))
+        self._step_count += k
+        return toks, logps
+
     def decode_many(self, k: int):
         """k fused decode steps; returns ([k, B] tokens, [k, B]
         logprobs) from one dispatch."""
         if k <= 1:
             toks, logps = self.decode()
             return toks[None, :], logps[None, :]
-        self._key, sub = jax.random.split(self._key)
-        toks, logps, self._cache, self._lengths, self._tokens = \
-            self._decode_many_jit(self.params, self._cache, self._lengths,
-                                  self._tokens, sub, self._temps,
-                                  self._topks, self._topps, k=k,
-                                  sampling_on=bool(
-                                      (self._host_temps > 0).any()))
-        self._step_count += k
-        toks_np, logps_np = jax.device_get((toks, logps))
+        toks_np, logps_np = jax.device_get(self.decode_many_dispatch(k))
         return np.asarray(toks_np), np.asarray(logps_np)
 
     # -- continuous batching --------------------------------------------- #
@@ -1032,23 +1050,28 @@ class Engine:
             # Dispatch step N+1 (device starts computing now) ...
             next_inflight = None
             if slots:
-                next_inflight = (self.decode_dispatch(), dict(slots))
+                k = max(1, self.cfg.online_decode_chunk)
+                next_inflight = (self.decode_many_dispatch(k),
+                                 dict(slots))
             # ... then read + process step N while it runs.
             if inflight is not None:
                 handles, live = inflight
                 tokens, logps = jax.device_get(handles)
                 tokens, logps = np.asarray(tokens), np.asarray(logps)
-                for slot_id, slot in live.items():
-                    if slots.get(slot_id) is not slot:
-                        # Finished (or refilled) after this step was
-                        # dispatched: its row is a wasted slot-step.
-                        continue
-                    tok = int(tokens[slot_id])
-                    slot.tokens.append(tok)
-                    slot.logprobs.append(float(logps[slot_id]))
-                    if not self._is_eos(tok):
-                        if slot.out_queue is not None:
-                            slot.out_queue.put((tok,
-                                                float(logps[slot_id])))
-                    self._finish_if_done(slots, slot_id, None)
+                if tokens.ndim == 1:        # k=1 single-step handles
+                    tokens, logps = tokens[None], logps[None]
+                for step in range(tokens.shape[0]):
+                    for slot_id, slot in live.items():
+                        if slots.get(slot_id) is not slot:
+                            # Finished (or refilled) after this chunk
+                            # was dispatched: wasted slot-step(s).
+                            continue
+                        tok = int(tokens[step, slot_id])
+                        slot.tokens.append(tok)
+                        lp = float(logps[step, slot_id])
+                        slot.logprobs.append(lp)
+                        if not self._is_eos(tok):
+                            if slot.out_queue is not None:
+                                slot.out_queue.put((tok, lp))
+                        self._finish_if_done(slots, slot_id, None)
             inflight = next_inflight

@@ -121,7 +121,8 @@ class ModelServer:
                  hf_model: Optional[str] = None,
                  kv_quantize: Optional[str] = None,
                  ckpt: Optional[str] = None,
-                 prefix_cache: int = 0):
+                 prefix_cache: int = 0,
+                 online_decode_chunk: int = 1):
         params = None
         eos_id = EOS_ID
 
@@ -182,7 +183,8 @@ class ModelServer:
                 batch_size=batch_size, max_decode_len=max_decode_len,
                 eos_id=eos_id, temperature=temperature,
                 quantize=quantize, kv_quantize=kv_quantize,
-                prefix_cache=prefix_cache))
+                prefix_cache=prefix_cache,
+                online_decode_chunk=online_decode_chunk))
         self.port = port
         self.ready = threading.Event()
         self.request_queue: queue.Queue = queue.Queue()
@@ -786,13 +788,21 @@ def main() -> None:
                              'common prefix (shared system prompts) '
                              'prefill only the suffix (cuts TTFT). '
                              '0 disables.')
+    parser.add_argument('--online-decode-chunk', type=int, default=1,
+                        help='fuse this many decode steps per host '
+                             'round trip in the serving loop (tokens '
+                             'stream in bursts of this size); raise '
+                             'over high-RTT relays where per-token '
+                             'syncs cap throughput')
     args = parser.parse_args()
     logger.info('devices: %s', jax.devices())
     ModelServer(args.model, args.port, args.batch_size,
                 args.max_decode_len, args.temperature,
                 args.quantize, args.tp, args.hf_model,
                 args.kv_quantize, ckpt=args.ckpt,
-                prefix_cache=args.prefix_cache).serve_forever()
+                prefix_cache=args.prefix_cache,
+                online_decode_chunk=args.online_decode_chunk
+                ).serve_forever()
 
 
 if __name__ == '__main__':

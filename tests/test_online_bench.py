@@ -23,13 +23,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _start_server(batch_size=4, max_admit_per_step=2):
+def _start_server(batch_size=4, max_admit_per_step=2,
+                  online_decode_chunk=1):
     eng = engine_lib.Engine(
         llama.llama_tiny(),
         engine_cfg=engine_lib.EngineConfig(
             batch_size=batch_size, max_decode_len=64,
             prefill_buckets=(8,), eos_id=-1,
-            max_admit_per_step=max_admit_per_step))
+            max_admit_per_step=max_admit_per_step,
+            online_decode_chunk=online_decode_chunk))
     port = _free_port()
     srv = engine_server.ModelServer.from_engine(eng, port,
                                                 model_name='tiny')
@@ -72,6 +74,24 @@ def test_online_benchmark_burst_exceeds_batch():
             max_tokens=6, concurrency=n, timeout_s=120)
         assert report['num_ok'] == n, report
         assert report['total_output_tokens'] == n * 6, report
+    finally:
+        srv.shutdown()
+
+
+def test_online_decode_chunk_full_budget_and_burst():
+    """Multi-step online decode (one host sync per k tokens): every
+    stream still delivers its exact token budget, including finishes
+    mid-chunk and refills beyond the batch size."""
+    srv, port = _start_server(batch_size=2, online_decode_chunk=4)
+    try:
+        n = 5
+        report = serving_bench.run_benchmark(
+            '127.0.0.1', port,
+            [[3, 4] for _ in range(n)],
+            max_tokens=7,            # not a multiple of the chunk
+            concurrency=n, timeout_s=120)
+        assert report['num_ok'] == n, report
+        assert report['total_output_tokens'] == n * 7, report
     finally:
         srv.shutdown()
 

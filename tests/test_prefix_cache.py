@@ -117,6 +117,30 @@ def test_burst_through_admit_hits_after_seed():
     assert eng.prefix_hits > hits_before
 
 
+def test_prefix_reuse_under_tp_mesh():
+    """Extend-prefill composes with tensor-parallel serving: the
+    prefix entries carry the kv sharding, the suffix forward runs
+    SPMD, and generations match a single-device cold engine."""
+    import jax
+
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    if jax.device_count() < 2:
+        pytest.skip('needs the virtual 8-device mesh')
+    tp_mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=2),
+                                 devices=jax.devices()[:2])
+    eng = engine_lib.Engine(
+        _cfg(), seed=7, mesh=tp_mesh,
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=128, prefill_buckets=(16, 64),
+            eos_id=-1, prefix_cache=4, prefix_grid=8))
+    eng.generate_batch([SYSTEM + [5, 6]], max_new_tokens=4)
+    out = eng.generate_batch([SYSTEM + [9, 10]], max_new_tokens=4)
+    assert eng.prefix_hits >= 1
+    cold = _engine(prefix_cache=0)
+    assert out == cold.generate_batch([SYSTEM + [9, 10]],
+                                      max_new_tokens=4)
+
+
 def test_reuse_declined_near_cache_capacity():
     """q + suffix_bucket overflowing the cache row declines reuse
     instead of corrupting the insert."""
