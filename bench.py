@@ -184,7 +184,7 @@ def _serving_throughput(device):
                 cfg, params=params,
                 engine_cfg=engine_lib.EngineConfig(
                     batch_size=batch, max_decode_len=max_len,
-                    prefill_buckets=(64,), decode_chunk=64,
+                    prefill_buckets=(64,), decode_chunk=128,
                     quantize=quantize,   # offline: throughput > latency
                     kv_quantize=kv_quantize))
             wbytes = _tree_bytes(eng.params)
@@ -199,13 +199,18 @@ def _serving_throughput(device):
             # Pure fused-decode steps/s for the roofline fraction (the
             # generate_batch number also pays prefill + host loop).
             # decode_many host-syncs internally (it device_gets the
-            # token block), so the timing needs no extra barrier.
+            # token block), so the timing needs no extra barrier. ONE
+            # 256-step fused call: through the axon tunnel each
+            # decode_many costs a ~90 ms host round-trip
+            # (scripts/chunk_sweep.py r5), so 4x64 would tax every
+            # step ~1.4 ms; the re-admit between warm and timed call
+            # keeps lengths inside the cache window.
             eng.admit([(s, [1] * 32) for s in range(batch)])
-            eng.decode_many(64)
+            eng.decode_many(256)                 # compile + warm
+            eng.admit([(s, [1] * 32) for s in range(batch)])
             t0 = time.perf_counter()
-            for _ in range(3):
-                eng.decode_many(64)
-            steps_per_s = 3 * 64 / (time.perf_counter() - t0)
+            eng.decode_many(256)
+            steps_per_s = 256 / (time.perf_counter() - t0)
             bytes_per_step = wbytes + cbytes
             roofline_steps = bw / bytes_per_step
             del eng

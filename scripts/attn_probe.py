@@ -1,0 +1,64 @@
+"""Pin down where the fused decode step loses bandwidth at long
+max_decode_len: sweep max_len and report device-side ms/step (big
+chunk so the tunnel RTT amortizes away).
+
+Historical note: this probe originally swept scan_layers True/False
+and showed unrolled-over-a-stacked-cache was WORSE (r5); the decode
+path has since moved to per-layer cache arrays with the layer loop
+always unrolled (models/llama.py decode_tail), so the scan dimension
+is gone — decode ignores cfg.scan_layers now.
+
+Usage: python scripts/attn_probe.py
+"""
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument('--batch', type=int, default=32)
+    p.add_argument('--chunk', type=int, default=128)
+    p.add_argument('--quantize', default='int8')
+    args = p.parse_args()
+    os.environ.setdefault('SKYT_INT8_KERNEL', '0')
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import engine as engine_lib
+
+    quant = args.quantize if args.quantize != 'none' else None
+    for max_len in (256, 1024):
+        cfg = llama.llama3_1b()
+        eng = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=args.batch, max_decode_len=max_len,
+                prefill_buckets=(32,), decode_chunk=args.chunk,
+                quantize=quant))
+        eng.admit([(s, [1] * 16) for s in range(args.batch)])
+        eng.decode_many(args.chunk)          # compile + warm
+        eng.admit([(s, [1] * 16) for s in range(args.batch)])
+        n = 1
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng.decode_many(args.chunk)
+        dt = time.perf_counter() - t0
+        ms_call = 1e3 * dt / n
+        print(json.dumps({
+            'max_len': max_len,
+            'ms_per_step_approx': round(
+                (ms_call - 88.0) / args.chunk, 3),
+            'ms_per_call': round(ms_call, 1)}))
+        del eng
+        gc.collect()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,73 @@
+"""Separate per-call (tunnel RTT / host) overhead from per-step device
+time in the fused decode path: sweep the fused-chunk size and fit
+  time(chunk) = chunk * t_step + t_call.
+If t_call dominates the gap to the HBM roofline, the fix is fewer host
+syncs (bigger chunks / dispatch-ahead), not kernel work.
+
+Usage: python scripts/chunk_sweep.py [--model llama3-1b] [--quantize int8]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument('--model', default='llama3-1b')
+    p.add_argument('--quantize', default='int8')
+    p.add_argument('--batch', type=int, default=32)
+    p.add_argument('--chunks', default='16,32,64,128')
+    p.add_argument('--kernel', default='0')
+    args = p.parse_args()
+
+    os.environ['SKYT_INT8_KERNEL'] = args.kernel
+    import jax
+
+    from skypilot_tpu.models import llama
+    from skypilot_tpu.serve import engine as engine_lib
+
+    quant = args.quantize if args.quantize != 'none' else None
+    cfg = getattr(llama, args.model.replace('-', '_').replace('.', '_'))()
+    rows = []
+    for chunk in [int(c) for c in args.chunks.split(',')]:
+        eng = engine_lib.Engine(
+            cfg, engine_cfg=engine_lib.EngineConfig(
+                batch_size=args.batch, max_decode_len=1024,
+                prefill_buckets=(32,), decode_chunk=chunk,
+                quantize=quant))
+        eng.admit([(s, [1] * 16) for s in range(args.batch)])
+        eng.decode_many(chunk)               # compile + warm
+        n_calls = max(2, 256 // chunk)
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            eng.decode_many(chunk)
+        dt = time.perf_counter() - t0
+        rows.append({'chunk': chunk,
+                     'ms_per_call': round(1e3 * dt / n_calls, 2),
+                     'ms_per_step': round(1e3 * dt / (n_calls * chunk), 3),
+                     'steps_per_s': round(n_calls * chunk / dt, 1)})
+        print(json.dumps(rows[-1]))
+        del eng
+        import gc
+        gc.collect()
+    # Least-squares fit time_per_call = t_call + chunk * t_step.
+    n = len(rows)
+    xs = [r['chunk'] for r in rows]
+    ys = [r['ms_per_call'] for r in rows]
+    mx, my = sum(xs) / n, sum(ys) / n
+    slope = (sum((x - mx) * (y - my) for x, y in zip(xs, ys))
+             / sum((x - mx) ** 2 for x in xs))
+    intercept = my - slope * mx
+    print(json.dumps({'fit_ms_per_step': round(slope, 3),
+                      'fit_ms_per_call_overhead': round(intercept, 2)}))
+
+
+if __name__ == '__main__':
+    main()

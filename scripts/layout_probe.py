@@ -1,0 +1,67 @@
+"""Isolate the decode-attention cache-layout cost: time the two
+attention einsums over a [B,T,KV,hd] cache (current layout) vs a
+[B,KV,T,hd] cache (transpose-free batched-matmul layout), 16 layers'
+worth per step, on the attached chip.
+
+Usage: python scripts/layout_probe.py
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, T, KV, G, HD, L = 32, 1024, 8, 4, 64, 16
+
+
+def attn_btkh(q, k, v):
+    scores = jnp.einsum('bkgh,btkh->bkgt', q, k,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bkgt,btkh->bkgh', probs.astype(v.dtype), v)
+
+
+def attn_bkth(q, k, v):
+    scores = jnp.einsum('bkgh,bkth->bkgt', q, k,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bkgt,bkth->bkgh', probs.astype(v.dtype), v)
+
+
+def run(name, fn, kshape):
+    keys = jax.random.split(jax.random.PRNGKey(0), 2 * L + 1)
+    q = jax.random.normal(keys[-1], (B, KV, G, HD), jnp.bfloat16)
+    ks = [jax.random.normal(keys[i], kshape, jnp.bfloat16)
+          for i in range(L)]
+    vs = [jax.random.normal(keys[L + i], kshape, jnp.bfloat16)
+          for i in range(L)]
+
+    @jax.jit
+    def step(q, ks, vs):
+        outs = [fn(q, k, v) for k, v in zip(ks, vs)]
+        return sum(jnp.sum(o.astype(jnp.float32)) for o in outs)
+
+    float(step(q, ks, vs))       # compile; host transfer = real sync
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = step(q, ks, vs)
+    float(r)
+    dt = time.perf_counter() - t0
+    ms = 1e3 * dt / n
+    nbytes = 2 * L * B * T * KV * HD * 2      # k+v bf16 reads
+    print(json.dumps({'layout': name, 'ms_per_step': round(ms, 3),
+                      'ideal_ms_819gbs': round(1e3 * nbytes / 819e9, 3)}))
+
+
+if __name__ == '__main__':
+    run('btkh', attn_btkh, (B, T, KV, HD))
+    run('bkth', attn_bkth, (B, KV, T, HD))

@@ -1,0 +1,101 @@
+"""Second layout probe: realistic decode-structure (stacked [L,...]
+cache, lax.scan over layers with dynamic_index_in_dim, per-step token
+scatter) comparing
+  a) bkth:   k,v both [L,B,KV,T,hd]   (current cache layout)
+  b) asym:   k [L,B,KV,hd,T], v [L,B,KV,T,hd]  (matmul-native layouts)
+The trace of the real engine shows XLA relayouting the k slice to
+T-minor every layer ({4,2,3,1,0} -> {3,4,2,1,0} copies); (b) stores it
+that way from the start.
+
+Usage: python scripts/layout_probe2.py
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, T, KV, G, HD, L = 32, 1024, 8, 4, 64, 16
+STEPS = 64
+
+
+def attn_bkth(q, k, v, lengths):
+    scores = jnp.einsum('bkgh,bkth->bkgt', q, k,
+                        preferred_element_type=jnp.float32)
+    mask = jnp.arange(T)[None] < lengths[:, None]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bkgt,bkth->bkgh', probs.astype(v.dtype), v)
+
+
+def attn_asym(q, k, v, lengths):
+    scores = jnp.einsum('bkgh,bkht->bkgt', q, k,
+                        preferred_element_type=jnp.float32)
+    mask = jnp.arange(T)[None] < lengths[:, None]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bkgt,bkth->bkgh', probs.astype(v.dtype), v)
+
+
+def make_step(attn, k_write_axis_last):
+    def step(cache_k, cache_v, lengths, q0):
+        def layer(carry, li):
+            x, k_all, v_all = carry
+            k_l = jax.lax.dynamic_index_in_dim(k_all, li, 0, False)
+            v_l = jax.lax.dynamic_index_in_dim(v_all, li, 0, False)
+            out = attn(x, k_l, v_l, lengths)            # [B,KV,G,HD]
+            nk = out.mean(axis=2)                       # fake new k [B,KV,HD]
+            rows = jnp.arange(B)
+            if k_write_axis_last:
+                k_all = k_all.at[li, rows, :, :, lengths].set(nk)
+            else:
+                k_all = k_all.at[li, rows, :, lengths].set(nk)
+            v_all = v_all.at[li, rows, :, lengths].set(nk)
+            x = x + out * 1e-3
+            return (x, k_all, v_all), None
+
+        def one(carry, _):
+            (x, k_all, v_all), _ = jax.lax.scan(
+                layer, carry, jnp.arange(L))
+            return (x, k_all, v_all), x.sum()
+
+        (x, cache_k, cache_v), outs = jax.lax.scan(
+            one, (q0, cache_k, cache_v), None, length=STEPS)
+        return outs.sum(), cache_k, cache_v
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def run(name, attn, kshape, k_last):
+    keys = jax.random.split(jax.random.PRNGKey(0), 3)
+    ck = jax.random.normal(keys[0], kshape, jnp.bfloat16)
+    cv = jax.random.normal(keys[1], (L, B, KV, T, HD), jnp.bfloat16)
+    q0 = jax.random.normal(keys[2], (B, KV, G, HD), jnp.bfloat16)
+    lengths = jnp.full((B,), 128, jnp.int32)
+    step = make_step(attn, k_last)
+    r, ck, cv = step(ck, cv, lengths, q0)
+    float(r)
+    n = 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r, ck, cv = step(ck, cv, lengths, q0)
+    float(r)
+    dt = time.perf_counter() - t0
+    ms = 1e3 * dt / (n * STEPS)
+    nbytes = 2 * L * B * T * KV * HD * 2
+    print(json.dumps({'variant': name, 'ms_per_step': round(ms, 3),
+                      'ideal_ms_819gbs': round(1e3 * nbytes / 819e9,
+                                               3)}))
+
+
+if __name__ == '__main__':
+    run('bkth', attn_bkth, (L, B, KV, T, HD), False)
+    run('asym', attn_asym, (L, B, KV, HD, T), True)

@@ -94,6 +94,14 @@ class LlamaConfig:
     # TPU (a pallas_call is opaque to GSPMD, so mesh serving keeps the
     # XLA path). Training never sets it.
     int8_kernel: Optional[str] = None
+    # Decode attention through the pallas online-softmax kernel
+    # (ops/decode_attention.py): 'tpu' on-chip, 'interpret' for CPU
+    # tests, None (default) = the _cached_attention einsum path. The
+    # serving engine sets it only on explicit opt-in
+    # (SKYT_DECODE_KERNEL=1): on v5e the per-layer einsum path
+    # measured faster (see the kernel's module docstring). Opaque to
+    # GSPMD, like int8_kernel — mesh serving keeps the einsum path.
+    attn_kernel: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -478,7 +486,27 @@ def attention_block(cfg: LlamaConfig, x: jax.Array, layer_params: Params,
     v = v.reshape(b, s, kv, hd)
     q = apply_rope(q, angles)
     k = apply_rope(k, angles)
-    if cache is not None:
+    if cache is not None and len(cache) == 4:
+        # Decode-kernel path: this layer's cache rides through; the
+        # step's k/v token is written first (single-element scatter)
+        # and the pallas kernel attends over lengths+1 positions
+        # including it. Returns the UPDATED layer cache as kv_out.
+        from skypilot_tpu.ops import decode_attention as da
+        k_l, v_l, lengths, rows = cache
+        k_l = write_decode_token(k_l, k[:, 0], rows, lengths)
+        v_l = write_decode_token(v_l, v[:, 0], rows, lengths)
+        qg = q.reshape(b, kv, h // kv, hd)
+        out = da.decode_attention(
+            qg, k_l, v_l, lengths + 1,
+            interpret=(cfg.attn_kernel == 'interpret'))
+        if out is None:
+            raise ValueError(
+                'decode kernel enabled but the cache window does not '
+                'block-tile; the engine should not have set '
+                'attn_kernel for this max_decode_len')
+        attn_out = out.reshape(b, s, h * hd)
+        kv_out = (k_l, v_l)
+    elif cache is not None:
         # Cache path: attend over previous tokens + this step's k/v
         # analytically; return only the fresh (k, v) token — the decode
         # skeleton owns the (tiny, in-place) cache write.
@@ -573,9 +601,10 @@ def forward(params: Params, tokens: jax.Array,
 # (reference examples/tpu/v6e/README.md:104-120): instead of shelling out
 # to an external engine, the cache layout and the single-token decode step
 # are in-framework. Layout:
-#     cache = {'k': [L, B, T, KV, hd], 'v': same}   (T = max_decode_len)
-# sharded P(None, batch, None, 'tp', None): one slot per batch row, KV
-# heads split over tp. `lengths[b]` counts tokens already in slot b;
+#     cache = {'k': tuple(L x [B, KV, hd, T]), 'v': same}
+# (T = max_decode_len), each layer leaf sharded KV_LAYER_SPEC (KV heads
+# split over tp) — see the layout rationale comment above
+# init_kv_cache. `lengths[b]` counts tokens already in slot b;
 # attention masks the cache to t < lengths[b] and scores this step's
 # fresh k/v as one extra analytic column (_cached_attention); the
 # skeleton then writes the new token at index lengths[b] with a
@@ -586,40 +615,61 @@ def forward(params: Params, tokens: jax.Array,
 # above); model modules without it (mixtral) prefill normally.
 SUPPORTS_PREFIX = True
 
-KV_CACHE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp', None)
-KV_LAYER_SPEC = P(('dp', 'fsdp'), None, 'tp', None)   # per-layer slice
-# Per-token scales of an int8 cache: [L, B, T, KV] (head_dim reduced).
-KV_SCALE_SPEC = P(None, ('dp', 'fsdp'), None, 'tp')
+# Cache layout: ONE array per layer (a tuple pytree), each
+# [B, KV, hd, T] — kv-head-major with T minor, NOT the model's
+# [B, S, KV, hd] activation layout, for three measured reasons
+# (r5 v5e traces, scripts/layout_probe*.py + profile_decode.py):
+#   * T minor is lane-aligned for any T % 128 == 0 window. head_dim
+#     minor at hd=64 < the 128-lane tile padded the RESIDENT cache to
+#     2x its logical bytes and decode streams the whole cache every
+#     step — layout alone halves cache traffic for hd-64 families.
+#   * Per-layer arrays: a stacked [L, ...] cache made XLA materialize
+#     a dynamic-slice copy of every layer's cache every decode step,
+#     then relayout it for the score matmul ({4,2,3,1,0} ->
+#     {3,4,2,1,0} copies — together ~36% of the step in the trace).
+#     Separate arrays consumed directly by an unrolled layer loop
+#     compile to copy-free reads (1.92 -> 1.41 ms/step at B=32,
+#     T=256, 16 layers).
+#   * It is the score matmul's native operand layout.
+KV_LAYER_SPEC = P(('dp', 'fsdp'), 'tp', None, None)   # per-layer leaf
+# Per-token scales of an int8 cache layer: [B, KV, T] (hd reduced).
+KV_SCALE_SPEC = P(('dp', 'fsdp'), 'tp', None)
 
 
 def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
                   quantized: bool = False) -> Params:
-    """KV cache; `quantized` stores int8 values + per-(token, kv-head)
-    fp32 scales (quant.QTensor leaves — a pytree, so jit/scan/sharding
-    plumbing is unchanged). Decode streams the whole cache every step,
-    so int8 halves its HBM traffic AND its residency (bigger decode
-    batches in the same chip)."""
-    shape = (cfg.n_layers, batch_size, max_len, cfg.n_kv_heads,
-             cfg.head_dim)
+    """KV cache {'k': tuple(L x [B, KV, hd, T]), 'v': ...};
+    `quantized` stores int8 values + per-(token, kv-head) fp32 scales
+    (quant.QTensor leaves — a pytree, so jit/sharding plumbing is
+    unchanged). Decode streams the whole cache every step, so int8
+    halves its HBM traffic AND its residency (bigger decode batches
+    in the same chip)."""
+    shape = (batch_size, cfg.n_kv_heads, cfg.head_dim, max_len)
     if quantized:
+        scale_shape = shape[:2] + (max_len,)      # [B, KV, T]
         def leaf():
             return quant.QTensor(
-                q=_shard(jnp.zeros(shape, jnp.int8), KV_CACHE_SPEC),
-                scale=_shard(jnp.zeros(shape[:-1], jnp.float32),
+                q=_shard(jnp.zeros(shape, jnp.int8), KV_LAYER_SPEC),
+                scale=_shard(jnp.zeros(scale_shape, jnp.float32),
                              KV_SCALE_SPEC))
-        return {'k': leaf(), 'v': leaf()}
-    return {'k': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC),
-            'v': _shard(jnp.zeros(shape, cfg.dtype), KV_CACHE_SPEC)}
+    else:
+        def leaf():
+            return _shard(jnp.zeros(shape, cfg.dtype), KV_LAYER_SPEC)
+    return {'k': tuple(leaf() for _ in range(cfg.n_layers)),
+            'v': tuple(leaf() for _ in range(cfg.n_layers))}
 
 
-def kv_cache_specs(quantized: bool = False) -> Params:
+def kv_cache_specs(quantized: bool = False, n_layers: int = 1) -> Params:
     """PartitionSpec tree matching init_kv_cache's structure (the
     engine's out_shardings need the QTensor sub-structure too)."""
     if quantized:
         def leaf():
-            return quant.QTensor(q=KV_CACHE_SPEC, scale=KV_SCALE_SPEC)
-        return {'k': leaf(), 'v': leaf()}
-    return {'k': KV_CACHE_SPEC, 'v': KV_CACHE_SPEC}
+            return quant.QTensor(q=KV_LAYER_SPEC, scale=KV_SCALE_SPEC)
+    else:
+        def leaf():
+            return KV_LAYER_SPEC
+    return {'k': tuple(leaf() for _ in range(n_layers)),
+            'v': tuple(leaf() for _ in range(n_layers))}
 
 
 def quantize_kv(x: jax.Array) -> 'quant.QTensor':
@@ -628,33 +678,52 @@ def quantize_kv(x: jax.Array) -> 'quant.QTensor':
 
 
 def _dense_kv(x) -> jax.Array:
-    """Dense view of a (possibly int8) cache slice; the int8->bf16
-    convert + scale fuse into the consuming attention matmul the same
-    way weight dequant does in quant.qdot."""
+    """Dense view of a (possibly int8) cache slice [.., KV, hd, T]
+    (scale [.., KV, T] — head_dim is axis -2); the int8->bf16 convert
+    + scale fuse into the consuming attention matmul the same way
+    weight dequant does in quant.qdot."""
     if isinstance(x, quant.QTensor):
-        return quant.dequantize(x, reduce_axes=(-1,))
+        return quant.dequantize(x, reduce_axes=(-2,))
     return x
+
+
+def write_decode_token(cache_leaf, new, rows, lengths):
+    """Scatter one step's fresh [B, KV, hd] k or v token into one
+    layer's [B, KV, hd, T] cache at T position lengths[b] — int8
+    caches quantize per (token, head) at write time. rows/lengths are
+    separated by basic slices, so numpy advanced-indexing moves the
+    [B] dims to the front: the target region is [B, KV(, hd)],
+    matching the token's shape."""
+    if isinstance(cache_leaf, quant.QTensor):
+        qt = quantize_kv(new)
+        return quant.QTensor(
+            q=cache_leaf.q.at[rows, :, :, lengths].set(qt.q),
+            scale=cache_leaf.scale.at[rows, :, lengths].set(qt.scale))
+    return cache_leaf.at[rows, :, :, lengths].set(
+        new.astype(cache_leaf.dtype))
 
 
 def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                       k_new: jax.Array, v_new: jax.Array,
                       lengths: jax.Array) -> jax.Array:
-    """q [B,1,H,hd]; k/v_cache [B,T,KV,hd] hold ONLY previous tokens
-    (positions t < lengths[b]); k/v_new [B,1,KV,hd] are this step's
-    fresh k/v, handled as one extra score column instead of being
-    scattered into the cache first. This keeps the decode step's cache
-    traffic read-only inside the layer — the skeleton (decode_tail)
-    writes the single new token column afterwards, so a step never
-    copies the full cache (HBM write traffic per step drops from
-    O(cache) to O(B*KV*hd) per layer)."""
+    """q [B,1,H,hd]; k/v_cache [B,KV,hd,T] (cache layout — see
+    the comment above init_kv_cache) hold ONLY previous tokens (positions
+    t < lengths[b]); k/v_new [B,1,KV,hd] are this step's fresh k/v,
+    handled as one extra score column instead of being scattered into
+    the cache first. This keeps the decode step's cache traffic
+    read-only inside the layer — the skeleton (decode_tail) writes the
+    single new token column afterwards, so a step never copies the
+    full cache (HBM write traffic per step drops from O(cache) to
+    O(B*KV*hd) per layer). This is the CPU/mesh fallback; single-chip
+    TPU decode routes through ops/decode_attention.py instead."""
     k_cache = _dense_kv(k_cache)   # int8 cache: dequant fuses into the
     v_cache = _dense_kv(v_cache)   # einsum reads (weights-style)
     b, _, h, hd = q.shape
-    t = k_cache.shape[1]
-    kv_heads = k_cache.shape[2]
+    kv_heads = k_cache.shape[1]
+    t = k_cache.shape[3]
     group = h // kv_heads
     q = q.reshape(b, kv_heads, group, hd)
-    scores = jnp.einsum('bkgh,btkh->bkgt', q, k_cache,
+    scores = jnp.einsum('bkgh,bkht->bkgt', q, k_cache,
                         preferred_element_type=jnp.float32)
     score_new = jnp.einsum('bkgh,bskh->bkgs', q, k_new,
                            preferred_element_type=jnp.float32)   # s == 1
@@ -663,7 +732,7 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     scores = jnp.where(mask[:, None, None], scores / scale, -1e30)
     allscores = jnp.concatenate([scores, score_new / scale], axis=-1)
     probs = jax.nn.softmax(allscores, axis=-1)              # [B,KV,G,T+1]
-    out = (jnp.einsum('bkgt,btkh->bkgh',
+    out = (jnp.einsum('bkgt,bkht->bkgh',
                       probs[..., :t].astype(v_cache.dtype), v_cache)
            + jnp.einsum('bkgs,bskh->bkgh',
                         probs[..., t:].astype(v_new.dtype), v_new))
@@ -673,71 +742,45 @@ def _cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def decode_tail(params: Params, cache: Params, lengths: jax.Array,
                 tokens: jax.Array, cfg: LlamaConfig, layer_body):
     """Shared decode-step skeleton (Llama + the MoE models): embed the
-    new token, scan `layer_body` over stacked layers, final-norm +
-    lm_head. `layer_body(x, layer_params, angles, (k_cache_layer,
+    new token, run `layer_body` over the layers (unrolled), final-norm
+    + lm_head. `layer_body(x, layer_params, angles, (k_cache_layer,
     v_cache_layer, lengths))` attends with the new token handled
     analytically and returns (x, (k_new, v_new)) — just this step's
     [B,1,KV,hd] token.
 
-    The full [L,B,T,KV,hd] cache rides the scan CARRY and each layer's
-    new token is written with a single-element scatter, so per decode
-    step the cache is read once (the attention must) and written
-    O(L*B*KV*hd) — not copied. The previous layout (cache as scan
-    xs/ys) re-materialized the entire cache through the stacked ys
-    buffer every step, which measured at ~32% of the v5e HBM roofline;
-    this layout is what lets the step approach bandwidth-bound."""
+    The cache is a TUPLE of per-layer [B,KV,hd,T] arrays consumed by
+    an unrolled layer loop: each layer's cache is read exactly once
+    (the attention must) and written with a single-element scatter —
+    never sliced out of a stacked array or copied. The two previous
+    designs both measured far off the v5e HBM roofline: cache as scan
+    ys re-materialized the whole cache every step (~32% of roofline),
+    and a stacked [L,...] scan carry made XLA materialize + relayout
+    every layer's slice (~36% of the step — see the KV layout comment
+    above init_kv_cache)."""
     angles = jax.vmap(
         lambda p: rope_frequencies(cfg, p[None]))(lengths)    # [B,1,half]
 
     x = _embed(params, tokens, cfg)[:, None]              # [B,1,D]
     rows = jnp.arange(tokens.shape[0])
+    use_kernel = getattr(cfg, 'attn_kernel', None) is not None
 
-    def shard_layer_slice(leaf):
-        if isinstance(leaf, quant.QTensor):
-            return quant.QTensor(
-                q=_shard(leaf.q, KV_LAYER_SPEC),
-                scale=_shard(leaf.scale, P(('dp', 'fsdp'), None, 'tp')))
-        return _shard(leaf, KV_LAYER_SPEC)
-
-    def write_token(cache_leaf, new, li):
-        """Scatter this step's [B,1,KV,hd] token into the full cache —
-        int8 caches quantize per (token, head) at write time."""
-        if isinstance(cache_leaf, quant.QTensor):
-            qt = quantize_kv(new[:, 0])
-            return quant.QTensor(
-                q=cache_leaf.q.at[li, rows, lengths].set(qt.q),
-                scale=cache_leaf.scale.at[li, rows, lengths].set(
-                    qt.scale))
-        return cache_leaf.at[li, rows, lengths].set(
-            new[:, 0].astype(cache_leaf.dtype))
-
-    def one_layer(x, k_all, v_all, layer_params, li, k_l, v_l):
-        k_l = shard_layer_slice(k_l)
-        v_l = shard_layer_slice(v_l)
-        x, (nk, nv) = layer_body(x, layer_params, angles,
-                                 (k_l, v_l, lengths))
-        return x, write_token(k_all, nk, li), write_token(v_all, nv, li)
-
-    if cfg.scan_layers:
-        def body(carry, xs):
-            x, k_all, v_all = carry
-            layer_params, li = xs
-            k_l, v_l = jax.tree.map(
-                lambda a: jax.lax.dynamic_index_in_dim(
-                    a, li, axis=0, keepdims=False), (k_all, v_all))
-            return one_layer(x, k_all, v_all, layer_params, li,
-                             k_l, v_l), None
-
-        (x, new_k, new_v), _ = jax.lax.scan(
-            body, (x, cache['k'], cache['v']),
-            (params['layers'], jnp.arange(cfg.n_layers)))
-    else:
-        new_k, new_v = cache['k'], cache['v']
-        for i in range(cfg.n_layers):
-            layer_params = jax.tree.map(lambda p: p[i], params['layers'])
-            k_l, v_l = jax.tree.map(lambda a: a[i], (new_k, new_v))
-            x, new_k, new_v = one_layer(x, new_k, new_v, layer_params,
-                                        i, k_l, v_l)
+    new_k, new_v = list(cache['k']), list(cache['v'])
+    for i in range(cfg.n_layers):
+        layer_params = jax.tree.map(lambda p: p[i], params['layers'])
+        if use_kernel:
+            # Kernel path: the layer cache flows INTO the layer; the
+            # attention block writes the token and returns it updated.
+            x, (new_k[i], new_v[i]) = layer_body(
+                x, layer_params, angles,
+                (new_k[i], new_v[i], lengths, rows))
+        else:
+            x, (nk, nv) = layer_body(x, layer_params, angles,
+                                     (new_k[i], new_v[i], lengths))
+            new_k[i] = write_decode_token(new_k[i], nk[:, 0], rows,
+                                          lengths)
+            new_v[i] = write_decode_token(new_v[i], nv[:, 0], rows,
+                                          lengths)
+    new_k, new_v = tuple(new_k), tuple(new_v)
     x = rms_norm(x, params['final_norm'], cfg.norm_eps)
     logits = quant.qeinsum('bsd,vd->bsv', x, params['lm_head'],
                            kernel=getattr(cfg, 'int8_kernel', None),

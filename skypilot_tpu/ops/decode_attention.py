@@ -1,0 +1,180 @@
+"""Pallas TPU kernel: batched decode attention over one layer's KV
+cache (the serving engine's per-token hot loop) — OPT-IN.
+
+Context (r5 v5e measurements, scripts/profile_decode.py traces +
+scripts/layout_probe*.py): the decode step attends one query token per
+sequence against the whole cache. Three structural fixes landed in the
+engine's DEFAULT path (models/llama.py):
+  * cache stored [B, KV, hd, T] per layer — T minor is lane-aligned
+    for any T % 128 == 0 window; head_dim minor at hd=64 < the
+    128-lane tile had padded the resident cache to 2x its logical
+    bytes, and decode streams the whole cache every step;
+  * one cache array PER LAYER (a tuple pytree) with the layer loop
+    unrolled — the stacked [L, ...] cache made XLA materialize a
+    dynamic-slice copy of every layer's cache every step, then
+    relayout it for the score matmul (~36% of the step in the trace);
+  * the fused einsum path then runs without any cache copy.
+This kernel is the next step beyond that: flash-style online softmax
+over T blocks so scores never round-trip through HBM, and explicit
+control of block shapes. Measured on v5e it does NOT yet beat the
+einsum path (GQA's tiny G dimension starves the MXU either way:
+kernel 2.0 ms vs einsum 1.4 ms per 16-layer step at B=32, T=256), so
+the engine keeps it opt-in (SKYT_DECODE_KERNEL=1) for chips where the
+tradeoff differs; 'interpret' drives the CPU parity tests.
+
+q [B, KV, G, hd]; k/v [B, KV, hd, T] dense bf16 or quant.QTensor
+(int8 q + [B, KV, T] f32 scales); lengths [B] counts valid positions
+INCLUDING the current token (already written at T index lengths-1).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+# T-block candidates, largest first; T (the cache window) must divide.
+# On hardware blocks must be lane-aligned (multiples of 128); the CPU
+# interpreter has no tiling constraint, so tests can run tiny windows
+# (and a 256 window still exercises the multi-block online softmax).
+_BLOCK_T = (512, 256, 128)
+_BLOCK_T_INTERPRET = (128, 64, 32, 16)
+_BLOCK_B = (8, 4, 2, 1)
+
+
+def _pick_block(dim: int, candidates) -> int:
+    for c in candidates:
+        if dim % c == 0:
+            return c
+    return 0
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+            *, nt: int, bb: int, block_t: int, kv: int, g: int, hd: int,
+            scale: float, quantized: bool, ks_ref=None, vs_ref=None):
+    """Grid (B/bb, nT). q [bb,KV,G,hd]; k/v [bb,KV,hd,BT]
+    (+ [bb,KV,BT] scales when int8); lengths [B] prefetched to SMEM;
+    out [bb,KV,G,hd]; f32 online-softmax scratch in VMEM."""
+    bi, ti = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[...].reshape(bb * kv, g, hd)
+    k = k_ref[...].reshape(bb * kv, hd, block_t)
+    v = v_ref[...].reshape(bb * kv, hd, block_t)
+    if quantized:
+        # Mirror quant.dequantize's rounding: int8 -> f32 * f32 scale,
+        # then down to bf16 for the MXU.
+        ks = ks_ref[...].reshape(bb * kv, 1, block_t)
+        vs = vs_ref[...].reshape(bb * kv, 1, block_t)
+        k = (k.astype(jnp.float32) * ks).astype(jnp.bfloat16)
+        v = (v.astype(jnp.float32) * vs).astype(jnp.bfloat16)
+    s = jax.lax.dot_general(                     # [bb*KV, G, BT]
+        q, k, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    s2 = s.reshape(bb * kv * g, block_t) * scale
+    row0 = bi * bb
+    pos1 = ti * block_t + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_t), 1)
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(pos1 < len_ref[row0 + i], (kv * g, block_t))
+         for i in range(bb)], axis=0)
+    s2 = jnp.where(mask, s2, _NEG_INF)
+    m_prev = m_scr[:, :1]
+    m_cur = jnp.max(s2, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # Fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+    p = jnp.where(s2 > _NEG_INF / 2, jnp.exp(s2 - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[...] = jnp.broadcast_to(
+        l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+        l_scr.shape)
+    p3 = p.reshape(bb * kv, g, block_t).astype(v.dtype)
+    o = jax.lax.dot_general(                     # [bb*KV, G, hd]
+        p3, v, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    acc_scr[...] = acc_scr[...] * alpha + o.reshape(bb * kv * g, hd)
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ti == nt - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).reshape(
+            bb, kv, g, hd).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache, v_cache,
+                     lengths: jax.Array,
+                     interpret: bool = False):
+    """One layer's decode attention. q [B, KV, G, hd]; k_cache/v_cache
+    [B, KV, hd, T] dense or quant.QTensor (scale [B, KV, T]); lengths
+    [B] int32 INCLUDING the current token. Returns [B, KV, G, hd] in
+    q.dtype, or None when T doesn't block-tile (caller falls back to
+    the einsum path)."""
+    from skypilot_tpu.ops import quant
+    quantized = isinstance(k_cache, quant.QTensor)
+    kq = k_cache.q if quantized else k_cache
+    vq = v_cache.q if quantized else v_cache
+    b, kv, hd, t = kq.shape
+    g = q.shape[2]
+    block_t = _pick_block(t, _BLOCK_T_INTERPRET if interpret
+                          else _BLOCK_T)
+    if not block_t:
+        return None
+    bb = _pick_block(b, _BLOCK_B)
+    nt = t // block_t
+
+    def kv_spec():
+        return pl.BlockSpec((bb, kv, hd, block_t),
+                            lambda bi, ti, s: (bi, 0, 0, ti))
+
+    def scale_spec():
+        return pl.BlockSpec((bb, kv, block_t),
+                            lambda bi, ti, s: (bi, 0, ti))
+
+    in_specs = [
+        pl.BlockSpec((bb, kv, g, hd), lambda bi, ti, s: (bi, 0, 0, 0)),
+        kv_spec(),
+        kv_spec(),
+    ]
+    operands = [q, kq, vq]
+    if quantized:
+        in_specs += [scale_spec(), scale_spec()]
+        operands += [k_cache.scale, v_cache.scale]
+
+    kernel = functools.partial(
+        _kernel, nt=nt, bb=bb, block_t=block_t, kv=kv, g=g, hd=hd,
+        scale=1.0 / (hd ** 0.5), quantized=quantized)
+    if quantized:
+        base = kernel
+
+        def kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, m_scr, l_scr, acc_scr):
+            return base(len_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, ks_ref=ks_ref,
+                        vs_ref=vs_ref)
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b // bb, nt),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((bb, kv, g, hd),
+                                   lambda bi, ti, s: (bi, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((bb * kv * g, 128), jnp.float32),
+                pltpu.VMEM((bb * kv * g, 128), jnp.float32),
+                pltpu.VMEM((bb * kv * g, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv, g, hd), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), *operands)

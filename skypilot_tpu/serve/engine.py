@@ -147,7 +147,7 @@ class Engine:
         expert parallelism — the reference's `vLLM --tensor-parallel-
         size` analog, llm/mixtral/serve.yaml:40). Weights are placed per
         the model's param_shardings (tp shards heads/ffn, ep shards
-        experts), the KV cache per llama.KV_CACHE_SPEC; XLA inserts the
+        experts), the KV cache per llama.KV_LAYER_SPEC; XLA inserts the
         per-layer collectives over ICI. Host-side slot logic is
         unchanged — every jitted step is one SPMD program."""
         self.model = model if model is not None else llama
@@ -171,25 +171,51 @@ class Engine:
                 raise ValueError(
                     f'unsupported {field} mode '
                     f'{getattr(self.cfg, field)!r} (only \'int8\')')
-        # int8 matmuls via the pallas in-kernel-dequant kernel on
-        # single-device TPU (ops/int8_matmul.py — XLA's convert-into-dot
-        # fusion is otherwise a gamble the decode roofline loses); a
-        # tp/ep mesh keeps the XLA path (pallas is opaque to GSPMD).
-        # SKYT_INT8_KERNEL=0 disables; =interpret forces the kernel's
-        # CPU interpreter (tests).
+        # int8 matmuls via the pallas in-kernel-dequant kernel
+        # (ops/int8_matmul.py) are OPT-IN: SKYT_INT8_KERNEL=1 enables
+        # on single-device TPU, =interpret forces the kernel's CPU
+        # interpreter (tests). Measured on v5e (scripts/
+        # profile_decode.py, r5): XLA's convert-into-dot fusion beats
+        # the hand kernel 1.27x on the fused decode step — the convert
+        # DOES fuse into the matmul read loop there — so the default
+        # stays XLA; the kernel remains for chips/XLA versions where
+        # that fusion regresses. A tp/ep mesh always keeps the XLA
+        # path (pallas is opaque to GSPMD).
         kernel_env = os.environ.get('SKYT_INT8_KERNEL', '')
         if (hasattr(model_cfg, 'int8_kernel')
                 and model_cfg.int8_kernel is None
-                and kernel_env != '0'
                 and mesh is None
                 and (self.cfg.quantize is not None or caller_params)):
             if kernel_env == 'interpret':
                 model_cfg = dataclasses.replace(model_cfg,
                                                 int8_kernel='interpret')
-            elif jax.default_backend() == 'tpu':
+            elif kernel_env == '1' and jax.default_backend() == 'tpu':
                 model_cfg = dataclasses.replace(model_cfg,
                                                 int8_kernel='tpu')
             self.model_cfg = model_cfg
+        # Decode attention through the pallas online-softmax kernel
+        # (ops/decode_attention.py) is OPT-IN (SKYT_DECODE_KERNEL=1 on
+        # TPU, =interpret for CPU tests): after the per-layer T-minor
+        # cache refactor the plain einsum path compiles copy-free and
+        # measured FASTER than the kernel on v5e (GQA's small G dim
+        # starves the MXU either way — see the kernel's module
+        # docstring). Mesh serving always keeps the einsum path
+        # (pallas is opaque to GSPMD).
+        da_env = os.environ.get('SKYT_DECODE_KERNEL', '')
+        if (hasattr(model_cfg, 'attn_kernel')
+                and getattr(model_cfg, 'attn_kernel', None) is None
+                and mesh is None):
+            if (da_env == 'interpret'
+                    and self.cfg.max_decode_len % 16 == 0):
+                model_cfg = dataclasses.replace(model_cfg,
+                                                attn_kernel='interpret')
+                self.model_cfg = model_cfg
+            elif (da_env == '1'
+                    and jax.default_backend() == 'tpu'
+                    and self.cfg.max_decode_len % 128 == 0):
+                model_cfg = dataclasses.replace(model_cfg,
+                                                attn_kernel='tpu')
+                self.model_cfg = model_cfg
         kv_q = self.cfg.kv_quantize is not None
         b, t = self.cfg.batch_size, self.cfg.max_decode_len
         cache = self.model.init_kv_cache(model_cfg, b, t, quantized=kv_q)
@@ -216,8 +242,9 @@ class Engine:
                     jax.tree.map(to_ns,
                                  self.model.quantized_param_shardings(
                                      model_cfg)))
-            cache_ns = jax.tree.map(to_ns,
-                                    self.model.kv_cache_specs(kv_q))
+            cache_ns = jax.tree.map(
+                to_ns, self.model.kv_cache_specs(
+                    kv_q, n_layers=model_cfg.n_layers))
             cache = jax.device_put(cache, cache_ns)
             repl = to_ns(P())
             kv_ns = {'k': to_ns(P(None, None, None, 'tp', None)),
@@ -427,17 +454,30 @@ class Engine:
         return toks[0], logps[0], kv
 
     @staticmethod
-    def _write_prefix_rows(cache_leaf, prefix_dense, slots, s):
-        """Write dense prefix kv [L,N,S,KV,hd] into cache rows `slots`
-        [N] — int8 caches quantize per (token, head) at write time."""
+    def _write_prefix_layer(cache_leaf, prefix_layer, slots, s):
+        """Write ONE layer's dense prefix kv [N,S,KV,hd] (model
+        layout) into cache rows `slots` [N] — the cache layer is
+        kv-head-major with T minor, [B,KV,hd,T] (llama KV layout
+        comment), so the prefix is transposed once here at the write
+        boundary; int8 caches quantize per (token, head) at write time
+        (head_dim is axis -2 after the transpose, hence
+        reduce_axes=(-2,))."""
         from skypilot_tpu.ops import quant
+        pre = jnp.transpose(prefix_layer, (0, 2, 3, 1))  # [N,KV,hd,S]
         if isinstance(cache_leaf, quant.QTensor):
-            qt = llama.quantize_kv(prefix_dense)
-            return quant.QTensor(
-                q=cache_leaf.q.at[:, slots, :s].set(qt.q),
-                scale=cache_leaf.scale.at[:, slots, :s].set(qt.scale))
-        return cache_leaf.at[:, slots, :s].set(
-            prefix_dense.astype(cache_leaf.dtype))
+            qt = quant.quantize(pre, reduce_axes=(-2,))
+            return quant.QTensor(                        # scale [N,KV,S]
+                q=cache_leaf.q.at[slots, :, :, :s].set(qt.q),
+                scale=cache_leaf.scale.at[slots, :, :s].set(qt.scale))
+        return cache_leaf.at[slots, :, :, :s].set(
+            pre.astype(cache_leaf.dtype))
+
+    def _write_prefix_rows(self, cache_leaves, prefix_dense, slots, s):
+        """Write dense prefix kv [L,N,S,KV,hd] into every layer of the
+        per-layer cache tuple."""
+        return tuple(
+            self._write_prefix_layer(leaf, prefix_dense[li], slots, s)
+            for li, leaf in enumerate(cache_leaves))
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
                      first_token, temps, topks, topps, temp, topk, topp):

@@ -1,0 +1,134 @@
+"""Decode-attention pallas kernel (ops/decode_attention.py) on the CPU
+interpreter: op-level parity against the einsum reference
+(_cached_attention) and engine-level greedy parity — the same contract
+the int8 matmul kernel tests pin (tests/test_int8_kernel.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.ops import decode_attention as da
+from skypilot_tpu.ops import quant
+from skypilot_tpu.serve import engine as engine_lib
+
+B, KV, G, HD, T = 3, 2, 4, 16, 256
+
+
+def _rand_cache(key, quantized=False):
+    """One layer's [B,KV,hd,T] cache pair."""
+    k1, k2 = jax.random.split(key)
+    k = jax.random.normal(k1, (B, KV, HD, T), jnp.float32)
+    v = jax.random.normal(k2, (B, KV, HD, T), jnp.float32)
+    if quantized:
+        return (quant.quantize(k, reduce_axes=(-2,)),
+                quant.quantize(v, reduce_axes=(-2,)))
+    return k.astype(jnp.bfloat16), v.astype(jnp.bfloat16)
+
+
+def _reference(q, k_l, v_l, lengths):
+    """Einsum softmax over the first lengths[b] positions of a layer's
+    [B,KV,hd,T] cache (the kernel's semantics: lengths INCLUDES the
+    current token, already written into the cache)."""
+    kd = quant.dequantize(k_l, reduce_axes=(-2,), dtype=jnp.float32) \
+        if isinstance(k_l, quant.QTensor) else k_l.astype(jnp.float32)
+    vd = quant.dequantize(v_l, reduce_axes=(-2,), dtype=jnp.float32) \
+        if isinstance(v_l, quant.QTensor) else v_l.astype(jnp.float32)
+    s = jnp.einsum('bkgh,bkht->bkgt', q.astype(jnp.float32), kd)
+    s = s / np.sqrt(HD)
+    mask = jnp.arange(T)[None] < lengths[:, None]
+    s = jnp.where(mask[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bkgt,bkht->bkgh', p, vd)
+
+
+@pytest.mark.parametrize('quantized', [False, True])
+def test_kernel_matches_einsum_reference(quantized):
+    key = jax.random.PRNGKey(0)
+    kq, kc = jax.random.split(key)
+    q = jax.random.normal(kq, (B, KV, G, HD), jnp.float32) \
+        .astype(jnp.bfloat16)
+    k_cache, v_cache = _rand_cache(kc, quantized)
+    # Ragged lengths incl. a block boundary (128) and a short row.
+    lengths = jnp.asarray([1, 128, 200], jnp.int32)
+    out = da.decode_attention(q, k_cache, v_cache, lengths,
+                              interpret=True)
+    assert out is not None and out.shape == (B, KV, G, HD)
+    ref = _reference(q, k_cache, v_cache, lengths)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.02)
+
+
+def test_kernel_multi_block_online_softmax():
+    """T=256 with interpret blocks of 128 runs nt=2 — the online
+    max/sum rescale path must agree with the one-shot softmax."""
+    key = jax.random.PRNGKey(7)
+    kq, kc = jax.random.split(key)
+    q = jax.random.normal(kq, (B, KV, G, HD), jnp.bfloat16)
+    k_cache, v_cache = _rand_cache(kc)
+    lengths = jnp.asarray([256, 129, 255], jnp.int32)  # spans 2 blocks
+    out = da.decode_attention(q, k_cache, v_cache, lengths,
+                              interpret=True)
+    ref = _reference(q, k_cache, v_cache, lengths)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.02)
+
+
+def test_untileable_window_returns_none():
+    q = jnp.zeros((B, KV, G, HD), jnp.bfloat16)
+    k = jnp.zeros((B, KV, HD, 50), jnp.bfloat16)
+    assert da.decode_attention(q, k, k, jnp.ones((B,), jnp.int32),
+                               interpret=True) is None
+
+
+def _engine(cfg, kernel_env, monkeypatch, **ecfg):
+    monkeypatch.setenv('SKYT_DECODE_KERNEL', kernel_env)
+    return engine_lib.Engine(
+        cfg, seed=3, engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=256, prefill_buckets=(8,),
+            eos_id=-1, **ecfg))
+
+
+@pytest.mark.parametrize('ecfg', [{}, {'kv_quantize': 'int8'}])
+def test_engine_generations_match_with_kernel(monkeypatch, ecfg):
+    """Full engine on the kernel path must produce the same greedy
+    generations as the einsum path — bf16 and int8-KV caches."""
+    cfg = llama.llama_tiny()
+    prompts = [[5, 9, 23, 41], [7, 11]]
+    ref_eng = _engine(cfg, '0', monkeypatch, **ecfg)
+    assert ref_eng.model_cfg.attn_kernel is None
+    ref_out = ref_eng.generate_batch(prompts, max_new_tokens=8)
+
+    k_eng = _engine(cfg, 'interpret', monkeypatch, **ecfg)
+    assert k_eng.model_cfg.attn_kernel == 'interpret'
+    k_out = k_eng.generate_batch(prompts, max_new_tokens=8)
+    assert k_out == ref_out
+
+
+def test_mesh_engine_never_uses_decode_kernel(monkeypatch):
+    monkeypatch.setenv('SKYT_DECODE_KERNEL', 'interpret')
+    from skypilot_tpu.parallel import mesh as mesh_lib
+    if jax.device_count() < 2:
+        pytest.skip('needs the virtual 8-device mesh')
+    tp_mesh = mesh_lib.make_mesh(mesh_lib.MeshShape(tp=2),
+                                 devices=jax.devices()[:2])
+    eng = engine_lib.Engine(
+        llama.llama_tiny(), mesh=tp_mesh,
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=256, prefill_buckets=(8,),
+            eos_id=-1))
+    assert getattr(eng.model_cfg, 'attn_kernel', None) is None
+
+
+def test_unaligned_window_keeps_einsum_path(monkeypatch):
+    """max_decode_len that doesn't tile (interpret: % 16) must leave
+    the kernel off rather than die at trace time."""
+    monkeypatch.setenv('SKYT_DECODE_KERNEL', 'interpret')
+    eng = engine_lib.Engine(
+        llama.llama_tiny(), seed=3,
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=2, max_decode_len=60, prefill_buckets=(8,),
+            eos_id=-1))
+    assert getattr(eng.model_cfg, 'attn_kernel', None) is None
+    out = eng.generate_batch([[5, 9]], max_new_tokens=4)
+    assert len(out[0]) == 4

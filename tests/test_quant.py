@@ -164,14 +164,16 @@ def test_kv_cache_int8_structure_and_specs():
     from skypilot_tpu.ops import quant
     cfg = llama.llama_tiny()
     cache = llama.init_kv_cache(cfg, 2, 16, quantized=True)
-    assert isinstance(cache['k'], quant.QTensor)
-    assert cache['k'].q.dtype == jnp.int8
-    assert cache['k'].q.shape == (cfg.n_layers, 2, 16, cfg.n_kv_heads,
-                                  cfg.head_dim)
-    assert cache['k'].scale.shape == (cfg.n_layers, 2, 16,
-                                      cfg.n_kv_heads)
+    assert isinstance(cache['k'], tuple)
+    assert len(cache['k']) == cfg.n_layers
+    leaf = cache['k'][0]
+    assert isinstance(leaf, quant.QTensor)
+    assert leaf.q.dtype == jnp.int8
+    assert leaf.q.shape == (2, cfg.n_kv_heads, cfg.head_dim, 16)
+    assert leaf.scale.shape == (2, cfg.n_kv_heads, 16)
     import jax
-    specs = llama.kv_cache_specs(quantized=True)
+    specs = llama.kv_cache_specs(quantized=True,
+                                 n_layers=cfg.n_layers)
     assert (jax.tree_util.tree_structure(specs)
             == jax.tree_util.tree_structure(cache))
 
