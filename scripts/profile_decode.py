@@ -37,6 +37,14 @@ def main() -> None:
 
     import jax
 
+    try:   # share bench.py's persistent compile cache (8B: minutes)
+        jax.config.update('jax_compilation_cache_dir',
+                          '/tmp/skyt_jax_cache')
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          2.0)
+    except Exception:  # noqa: BLE001
+        pass
+
     from skypilot_tpu.models import llama
     from skypilot_tpu.serve import engine as engine_lib
 
