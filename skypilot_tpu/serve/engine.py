@@ -99,6 +99,16 @@ class EngineConfig:
     # one compiled extend program per (grid point, suffix bucket), and
     # anything shorter than one grid step is not worth reusing.
     prefix_grid: int = 64
+    # Chunked prefill (the vLLM feature): ONLINE-loop prompts longer
+    # than this many tokens prefill incrementally in chunks of (at
+    # most) this size through the extend-attention path, one chunk
+    # dispatched per decode iteration — a long arrival stalls every
+    # in-flight stream by one chunk's prefill, not the whole prompt's.
+    # Offline paths (admit / generate_batch) ignore it: they have no
+    # latency SLO to protect. 0 = off. Must be <= the largest prefill
+    # bucket. Compiles one extend program per (chunk-multiple prefix
+    # length, suffix bucket).
+    prefill_chunk: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -292,6 +302,13 @@ class Engine:
         self._prefix_store: 'collections.OrderedDict' = \
             collections.OrderedDict()
         self.prefix_hits = 0
+        self.chunked_prefills = 0       # completed chunked prefills
+        if (self.cfg.prefill_chunk > 0
+                and self.cfg.prefill_chunk > self._buckets[-1]):
+            raise ValueError(
+                f'prefill_chunk {self.cfg.prefill_chunk} exceeds the '
+                f'largest prefill bucket {self._buckets[-1]} — each '
+                f'chunk must fit a bucket')
 
         def out_s(*specs):
             return None if mesh is None else specs
@@ -644,15 +661,21 @@ class Engine:
             f'prompt length {n} exceeds largest prefill bucket '
             f'{self._buckets[-1]}')
 
-    def _validate(self, prompt: Sequence[int]) -> None:
+    def _validate(self, prompt: Sequence[int],
+                  bucketed: bool = True) -> None:
         """Raise ValueError for any prompt the engine cannot serve; the
         single source of truth for request validation (prefill, admit,
-        and the loops all route through it)."""
+        and the loops all route through it). `bucketed=False` skips
+        the whole-prompt bucket-fit check — the CHUNKED prefill path
+        never dispatches more than prefill_chunk tokens at once, so a
+        prompt only needs to fit the cache row, not a prefill
+        bucket."""
         if len(prompt) == 0:   # not `not prompt`: numpy arrays are
             raise ValueError('empty prompt')   # ambiguous under bool()
         if len(prompt) >= self.cfg.max_decode_len:
             raise ValueError('prompt longer than max_decode_len')
-        self._bucket(len(prompt))
+        if bucketed:
+            self._bucket(len(prompt))
         try:
             arr = np.asarray(prompt)
         except Exception as e:  # noqa: BLE001 — ragged/mixed content
@@ -718,6 +741,63 @@ class Engine:
         sp = self._sampling_or_default(sampling)
         tok, logp, kv = self._prefill_dispatch(prompt, sp)
         return int(tok), float(logp), kv
+
+    # -- chunked prefill (online loop) ---------------------------------- #
+
+    def _chunk_prefill_start(self, prompt, sp: SamplingParams) -> dict:
+        """State for an incremental prefill of a long prompt; the
+        online loop advances it one `_chunk_prefill_step` per decode
+        iteration. A prefix-store hit seeds the state (those tokens'
+        kv is already computed), composing the two features."""
+        state = {'prompt': list(prompt), 'sp': sp, 'done': 0,
+                 'kv': None}
+        found = self._find_prefix(prompt)
+        if found is not None:
+            q, key = found
+            state['kv'] = self._take_prefix(q, key)
+            state['done'] = q
+        return state
+
+    def _chunk_prefill_step(self, state: dict):
+        """Dispatch ONE chunk of the incremental prefill. Returns None
+        while incomplete; on the final chunk returns (device token,
+        device logprob, kv sliced to the prompt) — the token/logprob
+        are sampled from the prompt's true last position, exactly as a
+        monolithic prefill would."""
+        prompt, sp = state['prompt'], state['sp']
+        start, n = state['done'], len(prompt)
+        take = min(self.cfg.prefill_chunk, n - start)
+        bucket = self._bucket(take)
+        self._key, sub = jax.random.split(self._key)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :take] = prompt[start:start + take]
+        if state['kv'] is None:
+            # First chunk: plain bucketed prefill; only its kv is kept
+            # (the sampled token matters only on the final chunk).
+            tok, logp, kv = self._prefill_jit(
+                self.params, jnp.asarray(padded), take, sub,
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p),
+                sampling_on=sp.temperature > 0)
+        else:
+            tok, logp, kv = self._extend_jit(
+                self.params, state['kv']['k'], state['kv']['v'],
+                jnp.asarray(padded), take, sub,
+                jnp.float32(sp.temperature), jnp.int32(sp.top_k),
+                jnp.float32(sp.top_p),
+                sampling_on=sp.temperature > 0)
+        state['done'] = start + take
+        # Slice away bucket padding: every position handed to the next
+        # extend (or stored) must be a REAL token — the extend mask
+        # treats the whole prefix as visible.
+        kv = {'k': kv['k'][:, :, :state['done']],
+              'v': kv['v'][:, :, :state['done']]}
+        if state['done'] >= n:
+            self._store_prefix(prompt, kv, n)
+            self.chunked_prefills += 1
+            return tok, logp, kv
+        state['kv'] = kv
+        return None
 
     def insert(self, prefix_kv: Any, slot: int, length: int,
                first_token: int,
@@ -1013,15 +1093,38 @@ class Engine:
           burst of arrivals is prefetched a few requests per decode
           step instead of stalling every in-flight stream for the whole
           burst's prefill time.
+        * **Chunked prefill** (EngineConfig.prefill_chunk): a prompt
+          longer than the chunk size is prefilled incrementally, one
+          chunk dispatch per loop iteration interleaved with the
+          decode steps, so its admission stalls in-flight streams by
+          one chunk — not the whole prompt. One long prompt is in
+          chunked flight at a time; shorter requests that arrived
+          behind it may admit while it progresses (utilization over
+          strict arrival order, the standard continuous-batching
+          trade).
         """
         slots: Dict[int, _Slot] = {}
         waiting: collections.deque = collections.deque()
         next_id = 0
         # (device token/logp arrays, {slot_id: _Slot at dispatch time})
         inflight: Optional[Tuple[Any, Dict[int, _Slot]]] = None
+        # In-flight chunked prefill:
+        # {'state', 'max_new', 'out_q', 'slot'} — `slot` is reserved
+        # (excluded from admission) until the final chunk inserts.
+        partial: Optional[dict] = None
+        chunk_on = self.cfg.prefill_chunk > 0
+        def _peek_len(item) -> int:
+            """Length of a queued item's prompt; 0 on malformed input
+            (the normal admission path then pops and rejects it)."""
+            try:
+                return len(item[0])
+            except Exception:  # noqa: BLE001
+                return 0
+
         while not stop.is_set():
             # Drain the queue into a local FIFO (block only when idle).
-            block = not slots and not waiting and inflight is None
+            block = (not slots and not waiting and inflight is None
+                     and partial is None)
             try:
                 while True:
                     item = request_queue.get(block=block, timeout=0.2)
@@ -1034,18 +1137,85 @@ class Engine:
                 pass
             if stop.is_set():
                 break
+            free = [s for s in range(self.cfg.batch_size)
+                    if s not in slots
+                    and not (partial is not None
+                             and partial['slot'] == s)]
+            # Advance the in-flight chunked prefill by ONE chunk.
+            if partial is not None:
+                # The whole advance — chunk dispatch AND the
+                # completion's insert + host reads — is guarded: a
+                # deferred device error (e.g. OOM on the final kv
+                # concat) surfaces at the device_get, and the serving
+                # loop must outlive any single request, same contract
+                # as the wave path below.
+                try:
+                    done = self._chunk_prefill_step(partial['state'])
+                    if done is not None:
+                        tok_d, logp_d, kv = done
+                        st = partial['state']
+                        self.insert(kv, partial['slot'],
+                                    len(st['prompt']), tok_d,
+                                    sampling=st['sp'])
+                        first = int(jax.device_get(tok_d))
+                        flogp = float(jax.device_get(logp_d))
+                        out_q = partial['out_q']
+                        slots[partial['slot']] = _Slot(
+                            next_id, len(st['prompt']), [first],
+                            partial['max_new'], out_q,
+                            logprobs=[flogp])
+                        next_id += 1
+                        if (out_q is not None
+                                and not self._is_eos(first)):
+                            out_q.put((first, flogp))
+                        self._finish_if_done(slots, partial['slot'],
+                                             None)
+                        partial = None
+                except Exception as e:  # noqa: BLE001
+                    logger.warning('chunked prefill failed: %s', e)
+                    slots.pop(partial['slot'], None)
+                    if partial['out_q'] is not None:
+                        partial['out_q'].put(e)
+                        partial['out_q'].put(None)
+                    partial = None
+            # Route the next LONG prompt at the head of the queue into
+            # a fresh chunked prefill (one at a time).
+            if (partial is None and chunk_on and waiting and free
+                    and _peek_len(waiting[0])
+                    > self.cfg.prefill_chunk):
+                item = waiting.popleft()
+                prompt, max_new, out_q = item[0], item[1], item[2]
+                sp = item[3] if len(item) > 3 else None
+                try:
+                    # bucketed=False: the chunked path serves prompts
+                    # LONGER than the largest prefill bucket (its whole
+                    # point); each chunk fits a bucket by construction.
+                    self._validate(prompt, bucketed=False)
+                    sp = self._sampling_or_default(sp)
+                    partial = {
+                        'state': self._chunk_prefill_start(prompt, sp),
+                        'max_new': max_new, 'out_q': out_q,
+                        'slot': free.pop(0)}
+                except Exception as e:  # noqa: BLE001
+                    logger.warning('rejecting request: %s', e)
+                    if out_q is not None:
+                        out_q.put(e)
+                        out_q.put(None)
             # Admit in arrival order while slots are free; a burst of
             # waiting requests rides batched prefill (admit groups
             # same-bucket prompts into one dispatch). A bad request must
             # not kill the loop: validate up front, report it, move on.
-            free = [s for s in range(self.cfg.batch_size)
-                    if s not in slots]
+            # A long prompt at the head is left for the chunked path
+            # above (next iteration) rather than stalling the batch.
             wave = []
             meta = {}
             budget = (self.cfg.max_admit_per_step
                       if self.cfg.max_admit_per_step > 0
                       else self.cfg.batch_size)
             while waiting and free and len(wave) < budget:
+                if (chunk_on and _peek_len(waiting[0])
+                        > self.cfg.prefill_chunk):
+                    break
                 item = waiting.popleft()
                 prompt, max_new, out_q = item[0], item[1], item[2]
                 sp = item[3] if len(item) > 3 else None

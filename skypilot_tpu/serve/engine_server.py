@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import json
+import os
 import queue
 import threading
 import time
@@ -122,7 +123,8 @@ class ModelServer:
                  kv_quantize: Optional[str] = None,
                  ckpt: Optional[str] = None,
                  prefix_cache: int = 0,
-                 online_decode_chunk: int = 1):
+                 online_decode_chunk: int = 1,
+                 prefill_chunk: int = 0):
         params = None
         eos_id = EOS_ID
 
@@ -184,7 +186,8 @@ class ModelServer:
                 eos_id=eos_id, temperature=temperature,
                 quantize=quantize, kv_quantize=kv_quantize,
                 prefix_cache=prefix_cache,
-                online_decode_chunk=online_decode_chunk))
+                online_decode_chunk=online_decode_chunk,
+                prefill_chunk=prefill_chunk))
         self.port = port
         self.ready = threading.Event()
         self.request_queue: queue.Queue = queue.Queue()
@@ -754,6 +757,12 @@ class ModelServer:
 
 
 def main() -> None:
+    # Honor JAX_PLATFORMS=cpu even under the axon TPU tunnel plugin,
+    # which self-registers regardless of the env var (same pin as
+    # bench.py / __graft_entry__.py) — a CPU-pinned server must not
+    # touch (or hang on) the tunnel.
+    if os.environ.get('JAX_PLATFORMS') == 'cpu':
+        jax.config.update('jax_platforms', 'cpu')
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--model', default='tiny',
                         choices=sorted(MODEL_PRESETS))
@@ -788,6 +797,13 @@ def main() -> None:
                              'common prefix (shared system prompts) '
                              'prefill only the suffix (cuts TTFT). '
                              '0 disables.')
+    parser.add_argument('--prefill-chunk', type=int, default=0,
+                        help='chunked prefill: prompts longer than '
+                             'this prefill in chunks of this size, '
+                             'interleaved with decode steps, so a '
+                             'long arrival cannot stall in-flight '
+                             'streams for its whole prefill. '
+                             '0 disables.')
     parser.add_argument('--online-decode-chunk', type=int, default=1,
                         help='fuse this many decode steps per host '
                              'round trip in the serving loop (tokens '
@@ -801,7 +817,8 @@ def main() -> None:
                 args.quantize, args.tp, args.hf_model,
                 args.kv_quantize, ckpt=args.ckpt,
                 prefix_cache=args.prefix_cache,
-                online_decode_chunk=args.online_decode_chunk
+                online_decode_chunk=args.online_decode_chunk,
+                prefill_chunk=args.prefill_chunk
                 ).serve_forever()
 
 
