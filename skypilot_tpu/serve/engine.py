@@ -123,10 +123,21 @@ class SamplingParams:
     above the pool is rejected loudly (Engine.validate_sampling), and
     top_p is exact whenever the nucleus fits in the pool — the
     practical case (see tests/test_sampling_quality.py for the
-    distributional guarantee and the fallback behavior)."""
+    distributional guarantee and the fallback behavior).
+
+    frequency_penalty / presence_penalty follow the OpenAI API
+    ([-2, 2], validated): each next-token distribution is computed
+    from logits minus `frequency_penalty * count(token)` minus
+    `presence_penalty * (count(token) > 0)`, where counts cover the
+    tokens GENERATED so far in this request (vLLM semantics — the
+    prompt is not penalized). They apply under greedy decoding too;
+    reported logprobs remain the UNPENALIZED model probabilities
+    (same convention as temperature)."""
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
 
 
 @dataclasses.dataclass
@@ -283,18 +294,33 @@ class Engine:
         self._temps = jnp.full((b,), self.cfg.temperature, jnp.float32)
         self._topks = jnp.zeros((b,), jnp.int32)
         self._topps = jnp.ones((b,), jnp.float32)
+        self._freqs = jnp.zeros((b,), jnp.float32)
+        self._press = jnp.zeros((b,), jnp.float32)
+        # Per-slot generated-token counts for the OpenAI frequency /
+        # presence penalties. Allocated LAZILY at the first penalized
+        # insert (_ensure_counts): the full [B, V] int32 buffer is
+        # ~65 MB/chip for a 64-slot 256k-vocab engine, so servers that
+        # never see a penalty keep a [B, 1] placeholder (only read
+        # when the static penalties_on flag is on; a shape change just
+        # selects a different executable, exactly like the flag).
+        self._counts = jnp.zeros((b, 1), jnp.int32)
         # Host-side mirror of per-slot temperatures: decides the STATIC
         # sampling_on flag per dispatch and is reset when a slot
         # finishes (the device row may stay stale — dead rows' samples
-        # are discarded host-side).
+        # are discarded host-side). _host_pens mirrors the penalties
+        # for the penalties_on flag the same way.
         self._host_temps = np.full((b,), self.cfg.temperature,
                                    np.float32)
+        self._host_pens = np.zeros((b,), np.float32)
         if mesh is not None:
             self._lengths = jax.device_put(self._lengths, repl)
             self._tokens = jax.device_put(self._tokens, repl)
             self._temps = jax.device_put(self._temps, repl)
             self._topks = jax.device_put(self._topks, repl)
             self._topps = jax.device_put(self._topps, repl)
+            self._freqs = jax.device_put(self._freqs, repl)
+            self._press = jax.device_put(self._press, repl)
+            self._counts = jax.device_put(self._counts, repl)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
         # Prefix-KV store: prompt token array -> dense kv sliced to the
@@ -331,19 +357,23 @@ class Engine:
             static_argnames=('sampling_on',),
             out_shardings=out_s(repl, repl, kv_ns))
         self._insert_jit = jax.jit(
-            self._insert_impl, donate_argnums=(0,),
-            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
+            self._insert_impl, donate_argnums=(0, 10),
+            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
+                                repl, repl, repl))
         self._insert_many_jit = jax.jit(
-            self._insert_many_impl, donate_argnums=(0,),
-            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl))
+            self._insert_many_impl, donate_argnums=(0, 10),
+            out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
+                                repl, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
-            static_argnames=('sampling_on',), donate_argnums=(1,),
-            out_shardings=out_s(repl, repl, cache_ns, repl))
+            static_argnames=('sampling_on', 'penalties_on'),
+            donate_argnums=(1, 8),
+            out_shardings=out_s(repl, repl, cache_ns, repl, repl))
         self._decode_many_jit = jax.jit(
             functools.partial(self._decode_many_impl, cfg=model_cfg),
-            static_argnames=('k', 'sampling_on'), donate_argnums=(1,),
-            out_shardings=out_s(repl, repl, cache_ns, repl, repl))
+            static_argnames=('k', 'sampling_on', 'penalties_on'),
+            donate_argnums=(1, 8),
+            out_shardings=out_s(repl, repl, cache_ns, repl, repl, repl))
 
     # -- device programs ------------------------------------------------ #
 
@@ -363,34 +393,60 @@ class Engine:
             raise ValueError(
                 f'top_p must be positive, got {sp.top_p} '
                 '(>= 1 disables the nucleus filter)')
+        for name in ('frequency_penalty', 'presence_penalty'):
+            v = getattr(sp, name)
+            if not -2.0 <= v <= 2.0:
+                raise ValueError(
+                    f'{name} must be in [-2, 2] (OpenAI range), '
+                    f'got {v}')
+            if v != 0.0 and getattr(self.model_cfg, 'vocab_size',
+                                    None) is None:
+                # Counts are [B, vocab]; without a declared vocab the
+                # penalty would silently no-op — refuse loudly.
+                raise ValueError(
+                    f'{name} requires the model config to declare '
+                    'vocab_size')
 
     def _sample(self, logits: jax.Array, key: jax.Array,
                 temps: jax.Array, topks: jax.Array, topps: jax.Array,
-                sampling_on: bool):
+                sampling_on: bool, counts=None, freqs=None, press=None,
+                penalties_on: bool = False):
         """Batched per-row sampling: logits [B, V], per-row temperature
         (<=0 greedy), top-k (<=0 off) and top-p (>=1 off). Returns
         (tokens [B], logprobs [B]) — the chosen token's UNSCALED
         log-softmax (the model probability, OpenAI `logprobs`
         convention), one fused vocab reduction on top of the argmax.
 
-        `sampling_on` is STATIC (host-tracked: engine slot bookkeeping
-        knows whether any live request samples): all-greedy batches —
-        the throughput/default-server case — compile to a pure argmax
-        program with no vocab-wide top_k/categorical at all; at most
-        two executables exist per step shape."""
+        `sampling_on` / `penalties_on` are STATIC (host-tracked: engine
+        slot bookkeeping knows whether any live request samples or
+        penalizes): all-greedy no-penalty batches — the
+        throughput/default-server case — compile to a pure argmax
+        program with no vocab-wide top_k/categorical and no [B, V]
+        counts read at all.
+
+        With penalties on, the selection distribution is
+        logits - freqs*counts - press*(counts>0) (counts [B, V] =
+        tokens generated so far per slot); the REPORTED logprob stays
+        the unpenalized model probability."""
         logits = logits.astype(jnp.float32)
         lse_raw = jax.nn.logsumexp(logits, axis=-1)              # [B]
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
         def logprob_of(tok):
             return (jnp.take_along_axis(logits, tok[:, None],
                                         axis=-1)[:, 0] - lse_raw)
 
+        sel = logits
+        if penalties_on:
+            sel = (logits
+                   - freqs[:, None] * counts.astype(jnp.float32)
+                   - press[:, None] * (counts > 0))
+        greedy = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+
         if not sampling_on:
             return greedy, logprob_of(greedy)
 
         safe_t = jnp.where(temps > 0, temps, 1.0)[:, None]
-        scaled = logits / safe_t
+        scaled = sel / safe_t
         kk = min(self._MAX_TOPK, scaled.shape[-1])
         vals, _ = jax.lax.top_k(scaled, kk)                   # [B, kk]
         k = jnp.clip(jnp.where(topks <= 0, kk, topks), 1, kk)
@@ -497,8 +553,11 @@ class Engine:
             for li, leaf in enumerate(cache_leaves))
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
-                     first_token, temps, topks, topps, temp, topk, topp):
-        """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`."""
+                     first_token, temps, topks, topps, counts, freqs,
+                     press, temp, topk, topp, fpen, ppen):
+        """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`. Penalty
+        counts restart at the first generated token (output-only
+        semantics)."""
         s = prefix_kv['k'].shape[2]
         slots = jnp.asarray(slot)[None]
         new_cache = {
@@ -510,7 +569,12 @@ class Engine:
         temps = temps.at[slot].set(temp)
         topks = topks.at[slot].set(topk)
         topps = topps.at[slot].set(topp)
-        return new_cache, lengths, tokens, temps, topks, topps
+        freqs = freqs.at[slot].set(fpen)
+        press = press.at[slot].set(ppen)
+        counts = counts.at[slot].set(0)
+        counts = counts.at[slot, first_token].add(1)
+        return (new_cache, lengths, tokens, temps, topks, topps,
+                counts, freqs, press)
 
     def _extend_impl(self, params, prefix_k, prefix_v, tokens, true_len,
                      key, temp, topk, topp, cfg, sampling_on):
@@ -611,9 +675,12 @@ class Engine:
 
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
                           lengths, tokens, first_tokens, temps, topks,
-                          topps, temps_new, topks_new, topps_new):
+                          topps, counts, freqs, press, temps_new,
+                          topks_new, topps_new, freqs_new, press_new):
         """Scatter prefix kv [L,N,S,KV,hd] into cache rows `slots` [N]
-        (distinct), one device program for the whole wave."""
+        (distinct), one device program for the whole wave. Penalty
+        counts restart at the first generated token (output-only
+        semantics)."""
         s = prefix_kv['k'].shape[2]
         new_cache = {
             name: self._write_prefix_rows(cache[name], prefix_kv[name],
@@ -624,32 +691,50 @@ class Engine:
         temps = temps.at[slots].set(temps_new)
         topks = topks.at[slots].set(topks_new)
         topps = topps.at[slots].set(topps_new)
-        return new_cache, lengths, tokens, temps, topks, topps
+        freqs = freqs.at[slots].set(freqs_new)
+        press = press.at[slots].set(press_new)
+        counts = counts.at[slots].set(0)
+        counts = counts.at[slots, first_tokens].add(1)
+        return (new_cache, lengths, tokens, temps, topks, topps,
+                counts, freqs, press)
 
     def _decode_impl(self, params, cache, lengths, tokens, key, temps,
-                     topks, topps, cfg, sampling_on):
+                     topks, topps, counts, freqs, press, cfg,
+                     sampling_on, penalties_on):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
         next_tokens, logps = self._sample(logits, key, temps, topks,
-                                          topps, sampling_on)
-        return next_tokens, logps, new_cache, lengths + 1
+                                          topps, sampling_on,
+                                          counts=counts, freqs=freqs,
+                                          press=press,
+                                          penalties_on=penalties_on)
+        if penalties_on:
+            rows = jnp.arange(next_tokens.shape[0])
+            counts = counts.at[rows, next_tokens].add(1)
+        return next_tokens, logps, new_cache, lengths + 1, counts
 
     def _decode_many_impl(self, params, cache, lengths, tokens, key,
-                          temps, topks, topps, k, cfg, sampling_on):
+                          temps, topks, topps, counts, freqs, press,
+                          k, cfg, sampling_on, penalties_on):
         """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
         One dispatch + one host transfer per k tokens."""
         def body(carry, subkey):
-            cache, lengths, tokens = carry
+            cache, lengths, tokens, counts = carry
             logits, cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
             nt, lp = self._sample(logits, subkey, temps, topks, topps,
-                                  sampling_on)
-            return (cache, lengths + 1, nt), (nt, lp)
+                                  sampling_on, counts=counts,
+                                  freqs=freqs, press=press,
+                                  penalties_on=penalties_on)
+            if penalties_on:
+                rows = jnp.arange(nt.shape[0])
+                counts = counts.at[rows, nt].add(1)
+            return (cache, lengths + 1, nt, counts), (nt, lp)
 
         keys = jax.random.split(key, k)
-        (cache, lengths, tokens), (toks, logps) = jax.lax.scan(
-            body, (cache, lengths, tokens), keys)
-        return toks, logps, cache, lengths, tokens
+        (cache, lengths, tokens, counts), (toks, logps) = jax.lax.scan(
+            body, (cache, lengths, tokens, counts), keys)
+        return toks, logps, cache, lengths, tokens, counts
 
     # -- host-side API --------------------------------------------------- #
 
@@ -799,17 +884,42 @@ class Engine:
         state['kv'] = kv
         return None
 
+    def _ensure_counts(self, sp: SamplingParams) -> None:
+        """Grow the lazily-allocated penalty-counts buffer to [B, V]
+        the first time a penalized request arrives (validate_sampling
+        already guaranteed vocab_size exists). Never shrinks — the
+        executable choice is keyed on the static penalties_on flag
+        plus this shape."""
+        if (sp.frequency_penalty == 0.0
+                and sp.presence_penalty == 0.0):
+            return
+        v = self.model_cfg.vocab_size
+        if self._counts.shape[1] != v:
+            counts = jnp.zeros((self.cfg.batch_size, v), jnp.int32)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+                counts = jax.device_put(
+                    counts, NamedSharding(self.mesh, P()))
+            self._counts = counts
+
     def insert(self, prefix_kv: Any, slot: int, length: int,
                first_token: int,
                sampling: Optional[SamplingParams] = None) -> None:
         sp = self._sampling_or_default(sampling)
+        self._ensure_counts(sp)
         self._host_temps[slot] = sp.temperature
+        self._host_pens[slot] = (abs(sp.frequency_penalty)
+                                 + abs(sp.presence_penalty))
         (self._cache, self._lengths, self._tokens, self._temps,
-         self._topks, self._topps) = self._insert_jit(
+         self._topks, self._topps, self._counts, self._freqs,
+         self._press) = self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
             self._tokens, first_token, self._temps, self._topks,
-            self._topps, jnp.float32(sp.temperature),
-            jnp.int32(sp.top_k), jnp.float32(sp.top_p))
+            self._topps, self._counts, self._freqs, self._press,
+            jnp.float32(sp.temperature),
+            jnp.int32(sp.top_k), jnp.float32(sp.top_p),
+            jnp.float32(sp.frequency_penalty),
+            jnp.float32(sp.presence_penalty))
 
     # Cap on one batched-prefill dispatch: bounds the transient
     # [L, N, S, KV, hd] prefill-kv buffer and the number of distinct
@@ -887,13 +997,27 @@ class Engine:
                     jnp.asarray(true_lens), sub, temps, topks, topps,
                     sampling_on=any(sp.temperature > 0
                                     for _s, _p, sp in chunk))
+                # numpy first: the host mirror needs these anyway, and
+                # the jit accepts numpy directly — no device round
+                # trip in a path built to defer host reads.
+                fpens = np.asarray(
+                    [sp.frequency_penalty for _s, _p, sp in chunk],
+                    np.float32)
+                ppens = np.asarray(
+                    [sp.presence_penalty for _s, _p, sp in chunk],
+                    np.float32)
+                for _s, _p, sp in chunk:
+                    self._ensure_counts(sp)
                 self._host_temps[slots] = np.asarray(temps)
+                self._host_pens[slots] = np.abs(fpens) + np.abs(ppens)
                 (self._cache, self._lengths, self._tokens, self._temps,
-                 self._topks, self._topps) = self._insert_many_jit(
+                 self._topks, self._topps, self._counts, self._freqs,
+                 self._press) = self._insert_many_jit(
                     self._cache, kv, jnp.asarray(slots),
                     jnp.asarray(true_lens), self._lengths,
                     self._tokens, toks, self._temps, self._topks,
-                    self._topps, temps, topks, topps)
+                    self._topps, self._counts, self._freqs,
+                    self._press, temps, topks, topps, fpens, ppens)
                 if self._prefix_enabled():
                     # Batched prefills seed the store too — a burst's
                     # first wave makes every later request a hit.
@@ -923,10 +1047,13 @@ class Engine:
         read is a network round trip, which would otherwise serialize
         with every step)."""
         self._key, sub = jax.random.split(self._key)
-        next_tokens, logps, self._cache, self._lengths = self._decode_jit(
+        (next_tokens, logps, self._cache, self._lengths,
+         self._counts) = self._decode_jit(
             self.params, self._cache, self._lengths, self._tokens, sub,
-            self._temps, self._topks, self._topps,
-            sampling_on=bool((self._host_temps > 0).any()))
+            self._temps, self._topks, self._topps, self._counts,
+            self._freqs, self._press,
+            sampling_on=bool((self._host_temps > 0).any()),
+            penalties_on=bool((self._host_pens > 0).any()))
         self._tokens = next_tokens
         self._step_count += 1
         return next_tokens, logps
@@ -946,12 +1073,17 @@ class Engine:
         if k <= 1:
             return self.decode_dispatch()
         self._key, sub = jax.random.split(self._key)
-        toks, logps, self._cache, self._lengths, self._tokens = \
+        (toks, logps, self._cache, self._lengths, self._tokens,
+         self._counts) = \
             self._decode_many_jit(self.params, self._cache,
                                   self._lengths, self._tokens, sub,
                                   self._temps, self._topks, self._topps,
+                                  self._counts, self._freqs,
+                                  self._press,
                                   k=k, sampling_on=bool(
-                                      (self._host_temps > 0).any()))
+                                      (self._host_temps > 0).any()),
+                                  penalties_on=bool(
+                                      (self._host_pens > 0).any()))
         self._step_count += k
         return toks, logps
 
@@ -1063,9 +1195,11 @@ class Engine:
                 slot.out_queue.put(None)        # end-of-stream
             del slots[slot_id]
             # Freed slot no longer pins the sampling executable: one
-            # sampled request must not disable the all-greedy fast
-            # path for the rest of the process lifetime.
+            # sampled (or penalized) request must not disable the
+            # all-greedy no-penalty fast path for the rest of the
+            # process lifetime.
             self._host_temps[slot_id] = self.cfg.temperature
+            self._host_pens[slot_id] = 0.0
 
     # -- online loop (used by the model server) -------------------------- #
 

@@ -221,7 +221,9 @@ class ModelServer:
 
     def _sampling_from(self, req: Dict[str, Any]
                        ) -> Optional[engine_lib.SamplingParams]:
-        if not any(k in req for k in ('temperature', 'top_k', 'top_p')):
+        if not any(k in req for k in
+                   ('temperature', 'top_k', 'top_p',
+                    'frequency_penalty', 'presence_penalty')):
             return None
         # Unspecified fields keep the SERVER's defaults (a request
         # asking only for top_p must not silently flip the temperature
@@ -230,7 +232,9 @@ class ModelServer:
             temperature=float(req.get('temperature',
                                       self.engine.cfg.temperature)),
             top_k=int(req.get('top_k', 0)),
-            top_p=float(req.get('top_p', 1.0)))
+            top_p=float(req.get('top_p', 1.0)),
+            frequency_penalty=float(req.get('frequency_penalty', 0.0)),
+            presence_penalty=float(req.get('presence_penalty', 0.0)))
         # Loud validation at the API boundary (engine re-validates):
         # silently clamping top_k>64 to 64 surprised clients.
         self.engine.validate_sampling(sp)
