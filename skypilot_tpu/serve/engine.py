@@ -138,6 +138,13 @@ class SamplingParams:
     top_p: float = 1.0
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # OpenAI logit_bias: {token_id: bias} with bias in [-100, 100],
+    # added to the logits before every sampling decision (greedy
+    # included; -100/+100 act as ban/force). At most 64 entries
+    # (validated loudly — the engine keeps a fixed [B, 64] sparse
+    # buffer so one SPMD program serves heterogeneous batches). A
+    # tuple of (id, bias) pairs is accepted too.
+    logit_bias: Any = None
 
 
 @dataclasses.dataclass
@@ -304,14 +311,23 @@ class Engine:
         # when the static penalties_on flag is on; a shape change just
         # selects a different executable, exactly like the flag).
         self._counts = jnp.zeros((b, 1), jnp.int32)
+        # Sparse per-slot logit_bias ([B, 64] ids + values, padding:
+        # id 0 with value 0 — a no-op add). Read only under the static
+        # biased_on flag.
+        self._bias_ids = jnp.zeros((b, self._MAX_LOGIT_BIAS),
+                                   jnp.int32)
+        self._bias_vals = jnp.zeros((b, self._MAX_LOGIT_BIAS),
+                                    jnp.float32)
         # Host-side mirror of per-slot temperatures: decides the STATIC
         # sampling_on flag per dispatch and is reset when a slot
         # finishes (the device row may stay stale — dead rows' samples
-        # are discarded host-side). _host_pens mirrors the penalties
-        # for the penalties_on flag the same way.
+        # are discarded host-side). _host_pens / _host_bias mirror the
+        # penalties and logit_bias for their static flags the same
+        # way.
         self._host_temps = np.full((b,), self.cfg.temperature,
                                    np.float32)
         self._host_pens = np.zeros((b,), np.float32)
+        self._host_bias = np.zeros((b,), bool)
         if mesh is not None:
             self._lengths = jax.device_put(self._lengths, repl)
             self._tokens = jax.device_put(self._tokens, repl)
@@ -321,6 +337,8 @@ class Engine:
             self._freqs = jax.device_put(self._freqs, repl)
             self._press = jax.device_put(self._press, repl)
             self._counts = jax.device_put(self._counts, repl)
+            self._bias_ids = jax.device_put(self._bias_ids, repl)
+            self._bias_vals = jax.device_put(self._bias_vals, repl)
         self._key = jax.random.PRNGKey(seed + 1)
         self._step_count = 0
         # Prefix-KV store: prompt token array -> dense kv sliced to the
@@ -346,36 +364,40 @@ class Engine:
             out_shardings=out_s(repl, repl, repl))
         self._prefill_jit = jax.jit(
             functools.partial(self._prefill_impl, cfg=model_cfg),
-            static_argnames=('sampling_on',),
+            static_argnames=('sampling_on', 'biased_on'),
             out_shardings=out_s(repl, repl, kv_ns))
         self._prefill_many_jit = jax.jit(
             functools.partial(self._prefill_many_impl, cfg=model_cfg),
-            static_argnames=('sampling_on',),
+            static_argnames=('sampling_on', 'biased_on'),
             out_shardings=out_s(repl, repl, kv_ns))
         self._extend_jit = jax.jit(
             functools.partial(self._extend_impl, cfg=model_cfg),
-            static_argnames=('sampling_on',),
+            static_argnames=('sampling_on', 'biased_on'),
             out_shardings=out_s(repl, repl, kv_ns))
         self._insert_jit = jax.jit(
             self._insert_impl, donate_argnums=(0, 10),
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
-                                repl, repl, repl))
+                                repl, repl, repl, repl, repl))
         self._insert_many_jit = jax.jit(
             self._insert_many_impl, donate_argnums=(0, 10),
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
-                                repl, repl, repl))
+                                repl, repl, repl, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
-            static_argnames=('sampling_on', 'penalties_on'),
+            static_argnames=('sampling_on', 'penalties_on',
+                             'biased_on'),
             donate_argnums=(1, 8),
             out_shardings=out_s(repl, repl, cache_ns, repl, repl))
         self._decode_many_jit = jax.jit(
             functools.partial(self._decode_many_impl, cfg=model_cfg),
-            static_argnames=('k', 'sampling_on', 'penalties_on'),
+            static_argnames=('k', 'sampling_on', 'penalties_on',
+                             'biased_on'),
             donate_argnums=(1, 8),
             out_shardings=out_s(repl, repl, cache_ns, repl, repl, repl))
 
     # -- device programs ------------------------------------------------ #
+
+    _MAX_LOGIT_BIAS = 64
 
     @property
     def _MAX_TOPK(self) -> int:
@@ -406,11 +428,28 @@ class Engine:
                 raise ValueError(
                     f'{name} requires the model config to declare '
                     'vocab_size')
+        if sp.logit_bias:
+            items = self._bias_items(sp)
+            if len(items) > self._MAX_LOGIT_BIAS:
+                raise ValueError(
+                    f'logit_bias supports at most '
+                    f'{self._MAX_LOGIT_BIAS} entries, got {len(items)}')
+            vocab = getattr(self.model_cfg, 'vocab_size', None)
+            for tid, bias in items.items():
+                if vocab is not None and not 0 <= tid < vocab:
+                    raise ValueError(
+                        f'logit_bias token id {tid} outside '
+                        f'[0, {vocab})')
+                if not -100.0 <= bias <= 100.0:
+                    raise ValueError(
+                        f'logit_bias value for token {tid} must be in '
+                        f'[-100, 100], got {bias}')
 
     def _sample(self, logits: jax.Array, key: jax.Array,
                 temps: jax.Array, topks: jax.Array, topps: jax.Array,
                 sampling_on: bool, counts=None, freqs=None, press=None,
-                penalties_on: bool = False):
+                penalties_on: bool = False, bias_ids=None,
+                bias_vals=None, biased_on: bool = False):
         """Batched per-row sampling: logits [B, V], per-row temperature
         (<=0 greedy), top-k (<=0 off) and top-p (>=1 off). Returns
         (tokens [B], logprobs [B]) — the chosen token's UNSCALED
@@ -426,8 +465,10 @@ class Engine:
 
         With penalties on, the selection distribution is
         logits - freqs*counts - press*(counts>0) (counts [B, V] =
-        tokens generated so far per slot); the REPORTED logprob stays
-        the unpenalized model probability."""
+        tokens generated so far per slot); with logit_bias on, the
+        sparse per-slot (bias_ids, bias_vals) [B, 64] pairs are added
+        on top (padding: id 0 / value 0). The REPORTED logprob stays
+        the unmodified model probability."""
         logits = logits.astype(jnp.float32)
         lse_raw = jax.nn.logsumexp(logits, axis=-1)              # [B]
 
@@ -440,6 +481,9 @@ class Engine:
             sel = (logits
                    - freqs[:, None] * counts.astype(jnp.float32)
                    - press[:, None] * (counts > 0))
+        if biased_on:
+            rows = jnp.arange(sel.shape[0])[:, None]
+            sel = sel.at[rows, bias_ids].add(bias_vals)
         greedy = jnp.argmax(sel, axis=-1).astype(jnp.int32)
 
         if not sampling_on:
@@ -517,13 +561,17 @@ class Engine:
                 [float(x) for x in np.asarray(top_lps)[:n]])
 
     def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
-                      topp, cfg, sampling_on):
+                      topp, bias_ids, bias_vals, cfg, sampling_on,
+                      biased_on):
         """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[0, true_len - 1]
         toks, logps = self._sample(last[None], key, temp[None],
-                                   topk[None], topp[None], sampling_on)
+                                   topk[None], topp[None], sampling_on,
+                                   bias_ids=bias_ids,
+                                   bias_vals=bias_vals,
+                                   biased_on=biased_on)
         return toks[0], logps[0], kv
 
     @staticmethod
@@ -554,7 +602,8 @@ class Engine:
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
                      first_token, temps, topks, topps, counts, freqs,
-                     press, temp, topk, topp, fpen, ppen):
+                     press, bias_ids, bias_vals, temp, topk, topp,
+                     fpen, ppen, bias_ids_new, bias_vals_new):
         """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`. Penalty
         counts restart at the first generated token (output-only
         semantics)."""
@@ -573,11 +622,14 @@ class Engine:
         press = press.at[slot].set(ppen)
         counts = counts.at[slot].set(0)
         counts = counts.at[slot, first_token].add(1)
+        bias_ids = bias_ids.at[slot].set(bias_ids_new)
+        bias_vals = bias_vals.at[slot].set(bias_vals_new)
         return (new_cache, lengths, tokens, temps, topks, topps,
-                counts, freqs, press)
+                counts, freqs, press, bias_ids, bias_vals)
 
     def _extend_impl(self, params, prefix_k, prefix_v, tokens, true_len,
-                     key, temp, topk, topp, cfg, sampling_on):
+                     key, temp, topk, topp, bias_ids, bias_vals, cfg,
+                     sampling_on, biased_on):
         """Extend prefill (prefix-KV reuse): `tokens` [1, S_bucket] is
         the SUFFIX of a prompt whose first P tokens' kv ([L, 1, P, KV,
         hd], all real tokens) is reused; RoPE positions are offset by
@@ -590,7 +642,10 @@ class Engine:
             return_kv=True, prefix={'k': prefix_k, 'v': prefix_v})
         last = logits[0, true_len - 1]
         toks, logps = self._sample(last[None], key, temp[None],
-                                   topk[None], topp[None], sampling_on)
+                                   topk[None], topp[None], sampling_on,
+                                   bias_ids=bias_ids,
+                                   bias_vals=bias_vals,
+                                   biased_on=biased_on)
         full = {'k': jnp.concatenate([prefix_k, kv['k']], axis=2),
                 'v': jnp.concatenate([prefix_v, kv['v']], axis=2)}
         return toks[0], logps[0], full
@@ -660,7 +715,8 @@ class Engine:
         self.prefill(list(tokens))
 
     def _prefill_many_impl(self, params, tokens, true_lens, key,
-                           temps, topks, topps, cfg, sampling_on):
+                           temps, topks, topps, bias_ids, bias_vals,
+                           cfg, sampling_on, biased_on):
         """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
         Returns (first_tokens [N], kv [L, N, S, KV, hd]). Rows are
         independent (causal attention; the MoE path pins a drop-free
@@ -670,13 +726,17 @@ class Engine:
                                         return_kv=True)
         last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
         toks, logps = self._sample(last, key, temps, topks, topps,
-                                   sampling_on)
+                                   sampling_on, bias_ids=bias_ids,
+                                   bias_vals=bias_vals,
+                                   biased_on=biased_on)
         return toks, logps, kv
 
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
                           lengths, tokens, first_tokens, temps, topks,
-                          topps, counts, freqs, press, temps_new,
-                          topks_new, topps_new, freqs_new, press_new):
+                          topps, counts, freqs, press, bias_ids,
+                          bias_vals, temps_new, topks_new, topps_new,
+                          freqs_new, press_new, bias_ids_new,
+                          bias_vals_new):
         """Scatter prefix kv [L,N,S,KV,hd] into cache rows `slots` [N]
         (distinct), one device program for the whole wave. Penalty
         counts restart at the first generated token (output-only
@@ -695,19 +755,25 @@ class Engine:
         press = press.at[slots].set(press_new)
         counts = counts.at[slots].set(0)
         counts = counts.at[slots, first_tokens].add(1)
+        bias_ids = bias_ids.at[slots].set(bias_ids_new)
+        bias_vals = bias_vals.at[slots].set(bias_vals_new)
         return (new_cache, lengths, tokens, temps, topks, topps,
-                counts, freqs, press)
+                counts, freqs, press, bias_ids, bias_vals)
 
     def _decode_impl(self, params, cache, lengths, tokens, key, temps,
-                     topks, topps, counts, freqs, press, cfg,
-                     sampling_on, penalties_on):
+                     topks, topps, counts, freqs, press, bias_ids,
+                     bias_vals, cfg, sampling_on, penalties_on,
+                     biased_on):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
         next_tokens, logps = self._sample(logits, key, temps, topks,
                                           topps, sampling_on,
                                           counts=counts, freqs=freqs,
                                           press=press,
-                                          penalties_on=penalties_on)
+                                          penalties_on=penalties_on,
+                                          bias_ids=bias_ids,
+                                          bias_vals=bias_vals,
+                                          biased_on=biased_on)
         if penalties_on:
             rows = jnp.arange(next_tokens.shape[0])
             counts = counts.at[rows, next_tokens].add(1)
@@ -715,7 +781,8 @@ class Engine:
 
     def _decode_many_impl(self, params, cache, lengths, tokens, key,
                           temps, topks, topps, counts, freqs, press,
-                          k, cfg, sampling_on, penalties_on):
+                          bias_ids, bias_vals, k, cfg, sampling_on,
+                          penalties_on, biased_on):
         """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
         One dispatch + one host transfer per k tokens."""
         def body(carry, subkey):
@@ -725,7 +792,10 @@ class Engine:
             nt, lp = self._sample(logits, subkey, temps, topks, topps,
                                   sampling_on, counts=counts,
                                   freqs=freqs, press=press,
-                                  penalties_on=penalties_on)
+                                  penalties_on=penalties_on,
+                                  bias_ids=bias_ids,
+                                  bias_vals=bias_vals,
+                                  biased_on=biased_on)
             if penalties_on:
                 rows = jnp.arange(nt.shape[0])
                 counts = counts.at[rows, nt].add(1)
@@ -772,6 +842,34 @@ class Engine:
                                   or int(arr.max()) >= vocab):
             raise ValueError(f'token id out of range [0, {vocab})')
 
+    def _bias_row(self, sp: SamplingParams):
+        """(ids [64] int32, vals [64] float32) numpy row for one
+        request's logit_bias (padding: id 0 / value 0 — a no-op
+        add)."""
+        ids = np.zeros((self._MAX_LOGIT_BIAS,), np.int32)
+        vals = np.zeros((self._MAX_LOGIT_BIAS,), np.float32)
+        for i, (tid, bias) in enumerate(self._bias_items(sp).items()):
+            ids[i] = tid
+            vals[i] = bias
+        return ids, vals
+
+    @staticmethod
+    def _bias_items(sp: SamplingParams) -> dict:
+        """Normalize logit_bias (dict or (id, bias) pairs) to an
+        int-keyed dict — LAST entry wins on duplicate ids, so the
+        tuple form cannot stack duplicates past the validated ±100
+        range. The single source both validate_sampling and
+        _bias_row use."""
+        if not sp.logit_bias:
+            return {}
+        items = (sp.logit_bias.items()
+                 if hasattr(sp.logit_bias, 'items') else sp.logit_bias)
+        return {int(tid): float(bias) for tid, bias in items}
+
+    @staticmethod
+    def _has_bias(sp: SamplingParams) -> bool:
+        return bool(sp.logit_bias)
+
     def _sampling_or_default(self, sampling) -> SamplingParams:
         if sampling is None:
             return SamplingParams(temperature=self.cfg.temperature)
@@ -794,6 +892,7 @@ class Engine:
             bucket = self._bucket(len(prompt) - q)
             if q + bucket > self.cfg.max_decode_len - 1:
                 found = None
+        bids, bvals = self._bias_row(sp)
         if found is not None:
             pre = self._take_prefix(q, key)
             suffix = list(prompt[q:])
@@ -803,7 +902,9 @@ class Engine:
                 self.params, pre['k'], pre['v'], jnp.asarray(padded),
                 len(suffix), sub, jnp.float32(sp.temperature),
                 jnp.int32(sp.top_k), jnp.float32(sp.top_p),
-                sampling_on=sp.temperature > 0)
+                bids[None], bvals[None],
+                sampling_on=sp.temperature > 0,
+                biased_on=self._has_bias(sp))
         else:
             bucket = self._bucket(len(prompt))
             padded = np.zeros((1, bucket), np.int32)
@@ -811,7 +912,9 @@ class Engine:
             tok, logp, kv = self._prefill_jit(
                 self.params, jnp.asarray(padded), len(prompt), sub,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p), sampling_on=sp.temperature > 0)
+                jnp.float32(sp.top_p), bids[None], bvals[None],
+                sampling_on=sp.temperature > 0,
+                biased_on=self._has_bias(sp))
         self._store_prefix(prompt, kv, len(prompt))
         return tok, logp, kv
 
@@ -856,21 +959,24 @@ class Engine:
         self._key, sub = jax.random.split(self._key)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :take] = prompt[start:start + take]
+        bids, bvals = self._bias_row(sp)
         if state['kv'] is None:
             # First chunk: plain bucketed prefill; only its kv is kept
             # (the sampled token matters only on the final chunk).
             tok, logp, kv = self._prefill_jit(
                 self.params, jnp.asarray(padded), take, sub,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p),
-                sampling_on=sp.temperature > 0)
+                jnp.float32(sp.top_p), bids[None], bvals[None],
+                sampling_on=sp.temperature > 0,
+                biased_on=self._has_bias(sp))
         else:
             tok, logp, kv = self._extend_jit(
                 self.params, state['kv']['k'], state['kv']['v'],
                 jnp.asarray(padded), take, sub,
                 jnp.float32(sp.temperature), jnp.int32(sp.top_k),
-                jnp.float32(sp.top_p),
-                sampling_on=sp.temperature > 0)
+                jnp.float32(sp.top_p), bids[None], bvals[None],
+                sampling_on=sp.temperature > 0,
+                biased_on=self._has_bias(sp))
         state['done'] = start + take
         # Slice away bucket padding: every position handed to the next
         # extend (or stored) must be a REAL token — the extend mask
@@ -910,16 +1016,20 @@ class Engine:
         self._host_temps[slot] = sp.temperature
         self._host_pens[slot] = (abs(sp.frequency_penalty)
                                  + abs(sp.presence_penalty))
+        self._host_bias[slot] = self._has_bias(sp)
+        bids, bvals = self._bias_row(sp)
         (self._cache, self._lengths, self._tokens, self._temps,
          self._topks, self._topps, self._counts, self._freqs,
-         self._press) = self._insert_jit(
+         self._press, self._bias_ids, self._bias_vals) = \
+            self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
             self._tokens, first_token, self._temps, self._topks,
             self._topps, self._counts, self._freqs, self._press,
+            self._bias_ids, self._bias_vals,
             jnp.float32(sp.temperature),
             jnp.int32(sp.top_k), jnp.float32(sp.top_p),
             jnp.float32(sp.frequency_penalty),
-            jnp.float32(sp.presence_penalty))
+            jnp.float32(sp.presence_penalty), bids, bvals)
 
     # Cap on one batched-prefill dispatch: bounds the transient
     # [L, N, S, KV, hd] prefill-kv buffer and the number of distinct
@@ -991,12 +1101,19 @@ class Engine:
                                     jnp.int32)
                 topps = jnp.asarray([sp.top_p for _s, _p, sp in chunk],
                                     jnp.float32)
+                brows = [self._bias_row(sp) for _s, _p, sp in chunk]
+                bids = np.stack([r[0] for r in brows])
+                bvals = np.stack([r[1] for r in brows])
+                chunk_biased = any(self._has_bias(sp)
+                                   for _s, _p, sp in chunk)
                 self._key, sub = jax.random.split(self._key)
                 toks, logps, kv = self._prefill_many_jit(
                     self.params, jnp.asarray(padded),
                     jnp.asarray(true_lens), sub, temps, topks, topps,
+                    bids, bvals,
                     sampling_on=any(sp.temperature > 0
-                                    for _s, _p, sp in chunk))
+                                    for _s, _p, sp in chunk),
+                    biased_on=chunk_biased)
                 # numpy first: the host mirror needs these anyway, and
                 # the jit accepts numpy directly — no device round
                 # trip in a path built to defer host reads.
@@ -1010,14 +1127,18 @@ class Engine:
                     self._ensure_counts(sp)
                 self._host_temps[slots] = np.asarray(temps)
                 self._host_pens[slots] = np.abs(fpens) + np.abs(ppens)
+                self._host_bias[slots] = [self._has_bias(sp)
+                                          for _s, _p, sp in chunk]
                 (self._cache, self._lengths, self._tokens, self._temps,
                  self._topks, self._topps, self._counts, self._freqs,
-                 self._press) = self._insert_many_jit(
+                 self._press, self._bias_ids, self._bias_vals) = \
+                    self._insert_many_jit(
                     self._cache, kv, jnp.asarray(slots),
                     jnp.asarray(true_lens), self._lengths,
                     self._tokens, toks, self._temps, self._topks,
                     self._topps, self._counts, self._freqs,
-                    self._press, temps, topks, topps, fpens, ppens)
+                    self._press, self._bias_ids, self._bias_vals,
+                    temps, topks, topps, fpens, ppens, bids, bvals)
                 if self._prefix_enabled():
                     # Batched prefills seed the store too — a burst's
                     # first wave makes every later request a hit.
@@ -1051,9 +1172,10 @@ class Engine:
          self._counts) = self._decode_jit(
             self.params, self._cache, self._lengths, self._tokens, sub,
             self._temps, self._topks, self._topps, self._counts,
-            self._freqs, self._press,
+            self._freqs, self._press, self._bias_ids, self._bias_vals,
             sampling_on=bool((self._host_temps > 0).any()),
-            penalties_on=bool((self._host_pens > 0).any()))
+            penalties_on=bool((self._host_pens > 0).any()),
+            biased_on=bool(self._host_bias.any()))
         self._tokens = next_tokens
         self._step_count += 1
         return next_tokens, logps
@@ -1079,11 +1201,14 @@ class Engine:
                                   self._lengths, self._tokens, sub,
                                   self._temps, self._topks, self._topps,
                                   self._counts, self._freqs,
-                                  self._press,
+                                  self._press, self._bias_ids,
+                                  self._bias_vals,
                                   k=k, sampling_on=bool(
                                       (self._host_temps > 0).any()),
                                   penalties_on=bool(
-                                      (self._host_pens > 0).any()))
+                                      (self._host_pens > 0).any()),
+                                  biased_on=bool(
+                                      self._host_bias.any()))
         self._step_count += k
         return toks, logps
 
@@ -1200,6 +1325,7 @@ class Engine:
             # process lifetime.
             self._host_temps[slot_id] = self.cfg.temperature
             self._host_pens[slot_id] = 0.0
+            self._host_bias[slot_id] = False
 
     # -- online loop (used by the model server) -------------------------- #
 

@@ -85,6 +85,21 @@ class _BadRequest(ValueError):
     pass
 
 
+def _parse_logit_bias(raw) -> Optional[Dict[int, float]]:
+    """OpenAI wire form: {"<token id>": bias}. Anything else is a
+    loud 400, not a handler-thread traceback."""
+    if raw is None:
+        return None
+    if not hasattr(raw, 'items'):
+        raise _BadRequest(
+            'logit_bias must be an object mapping token ids to bias '
+            'values')
+    try:
+        return {int(k): float(v) for k, v in raw.items()} or None
+    except (TypeError, ValueError) as e:
+        raise _BadRequest(f'malformed logit_bias: {e}')
+
+
 def _first_stop_match(text: str, stop: Optional[List[str]]) -> int:
     """Offset of the earliest stop-string match in `text`, or -1. The
     single matcher both the plain and streaming paths use — they must
@@ -223,7 +238,8 @@ class ModelServer:
                        ) -> Optional[engine_lib.SamplingParams]:
         if not any(k in req for k in
                    ('temperature', 'top_k', 'top_p',
-                    'frequency_penalty', 'presence_penalty')):
+                    'frequency_penalty', 'presence_penalty',
+                    'logit_bias')):
             return None
         # Unspecified fields keep the SERVER's defaults (a request
         # asking only for top_p must not silently flip the temperature
@@ -234,7 +250,10 @@ class ModelServer:
             top_k=int(req.get('top_k', 0)),
             top_p=float(req.get('top_p', 1.0)),
             frequency_penalty=float(req.get('frequency_penalty', 0.0)),
-            presence_penalty=float(req.get('presence_penalty', 0.0)))
+            presence_penalty=float(req.get('presence_penalty', 0.0)),
+            # OpenAI sends {"<token id as string>": bias}; normalize
+            # to int keys (validate_sampling checks range and count).
+            logit_bias=_parse_logit_bias(req.get('logit_bias')))
         # Loud validation at the API boundary (engine re-validates):
         # silently clamping top_k>64 to 64 surprised clients.
         self.engine.validate_sampling(sp)

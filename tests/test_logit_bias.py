@@ -1,0 +1,119 @@
+"""OpenAI logit_bias: per-request {token_id: bias} added to the logits
+before every sampling decision (first token included — it flows
+through the prefill/extend sample too), kept as a fixed [B, 64]
+sparse buffer so heterogeneous batches stay one SPMD program."""
+import threading
+
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve.engine import SamplingParams
+
+
+def _engine(**kw):
+    defaults = dict(batch_size=2, max_decode_len=128,
+                    prefill_buckets=(8,), eos_id=-1)
+    defaults.update(kw)
+    return engine_lib.Engine(
+        llama.llama_tiny(), seed=3,
+        engine_cfg=engine_lib.EngineConfig(**defaults))
+
+
+PROMPT = [5, 9, 23]   # greedy: 267, 267, 398, ...
+
+
+def test_force_and_ban_tokens():
+    """+100 forces a token everywhere (greedy argmax over biased
+    logits); -100 on the natural first choice bans it."""
+    eng = _engine()
+    base = eng.generate_batch([PROMPT], max_new_tokens=8)[0]
+    forced = eng.generate_batch(
+        [PROMPT], max_new_tokens=8,
+        sampling=SamplingParams(logit_bias={7: 100.0}))[0]
+    assert forced == [7] * 8          # incl. the FIRST token (prefill)
+    banned = eng.generate_batch(
+        [PROMPT], max_new_tokens=8,
+        sampling=SamplingParams(logit_bias={base[0]: -100.0}))[0]
+    assert banned[0] != base[0]
+    assert base[0] not in banned
+
+
+def test_no_bias_identical_and_mixed_batch():
+    eng = _engine()
+    solo = eng.generate_batch([PROMPT], max_new_tokens=8)[0]
+    outs = eng.generate_batch(
+        [PROMPT, PROMPT], max_new_tokens=8,
+        sampling=[SamplingParams(),
+                  SamplingParams(logit_bias={7: 100.0})])
+    assert outs[0] == solo            # unbiased slot untouched
+    assert outs[1] == [7] * 8
+
+
+def test_bias_cleared_on_slot_reuse():
+    eng = _engine(batch_size=1)
+    eng.generate_batch([PROMPT], max_new_tokens=4,
+                       sampling=SamplingParams(logit_bias={7: 100.0}))
+    base = _engine(batch_size=1).generate_batch(
+        [PROMPT], max_new_tokens=8)[0]
+    after = eng.generate_batch([PROMPT], max_new_tokens=8)[0]
+    assert after == base
+
+
+def test_validation():
+    eng = _engine()
+    with pytest.raises(ValueError, match='at most'):
+        eng.validate_sampling(SamplingParams(
+            logit_bias={i: 1.0 for i in range(65)}))
+    with pytest.raises(ValueError, match='outside'):
+        eng.validate_sampling(SamplingParams(logit_bias={99999: 1.0}))
+    with pytest.raises(ValueError, match='-100'):
+        eng.validate_sampling(SamplingParams(logit_bias={7: 200.0}))
+
+
+def test_duplicate_ids_last_wins():
+    """Tuple-of-pairs input with duplicate ids must not stack past the
+    validated range — last entry wins (dict semantics)."""
+    eng = _engine()
+    sp = SamplingParams(logit_bias=((7, 80.0), (7, 80.0)))
+    eng.validate_sampling(sp)
+    assert eng._bias_items(sp) == {7: 80.0}
+
+
+def test_http_logit_bias():
+    """OpenAI wire format: string token-id keys."""
+    import json
+    import socket
+    import urllib.request
+
+    from skypilot_tpu.serve import engine_server
+
+    eng = _engine()
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    srv = engine_server.ModelServer.from_engine(eng, port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    assert srv.ready.wait(timeout=120)
+    try:
+        body = json.dumps({
+            'model': 'model', 'prompt': PROMPT, 'max_tokens': 6,
+            'logit_bias': {'7': 100.0}}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/v1/completions', data=body,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out['usage']['completion_tokens'] == 6
+        # Malformed logit_bias (a list) is a 400, not a dead thread.
+        bad = json.dumps({'model': 'model', 'prompt': PROMPT,
+                          'max_tokens': 2,
+                          'logit_bias': [[7, 100]]}).encode()
+        req = urllib.request.Request(
+            f'http://127.0.0.1:{port}/v1/completions', data=bad,
+            headers={'Content-Type': 'application/json'})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
