@@ -460,6 +460,29 @@ class ModelServer:
                     raise _BadRequest(
                         'stream_options is only allowed when '
                         'stream is true')
+                # OpenAI n / best_of: generate best_of completions,
+                # return the n with the highest cumulative logprob
+                # (chat has n only). All ride the same continuous
+                # batch; usage counts every generated token, matching
+                # the OpenAI billing semantics for best_of.
+                n = int(req.get('n', 1))
+                best_of = int(req.get('best_of', n))
+                if chat and 'best_of' in req:
+                    raise _BadRequest(
+                        'best_of is not part of the chat API (use n)')
+                if n < 1 or best_of < n:
+                    raise _BadRequest(
+                        f'need 1 <= n <= best_of, got n={n} '
+                        f'best_of={best_of}')
+                if best_of > 16:
+                    raise _BadRequest('best_of is capped at 16')
+                if best_of > 1 and bool(req.get('stream', False)):
+                    # OpenAI also rejects best_of with streaming —
+                    # silently streaming ONE un-ranked completion
+                    # would look like best_of worked.
+                    raise _BadRequest(
+                        'n/best_of > 1 with stream=true is not '
+                        'supported')
                 out_q = self._enqueue(tokens, max_new, sampling)
                 if bool(req.get('stream', False)):
                     self._stream_openai(
@@ -468,85 +491,107 @@ class ModelServer:
                         include_usage=bool(
                             stream_opts.get('include_usage')))
                     return
-                toks, logps, error = self._collect(out_q)
-                if error is not None:
-                    self._error(400, str(error))
-                    return
-                text = server._decode_text(toks)
-                finish = 'length' if len(toks) >= max_new else 'stop'
-                cut = _first_stop_match(text, stop)
-                if cut >= 0:
-                    text = text[:cut]
-                    finish = 'stop'
-                logprobs_obj = None
-                if want_logprobs:
-                    # A stop-sequence cut truncates the token list to
-                    # the kept text.
-                    token_strs = server._token_strs(toks)
-                    kept_lps = [round(p, 6) for p in logps]
+                # best_of - 1 extra parallel generations (queue 0 was
+                # enqueued above, before the stream branch).
+                extra_qs = [self._enqueue(tokens, max_new, sampling)
+                            for _ in range(best_of - 1)]
+                results = [self._collect(q)
+                           for q in [out_q] + extra_qs]
+                for _t, _l, error in results:
+                    if error is not None:
+                        self._error(400, str(error))
+                        return
+
+                # echo+logprobs prompt scoring is per-REQUEST: one
+                # teacher-forced pass reused by every choice.
+                echo_score = None
+                if (not chat and req.get('echo') and want_logprobs):
+                    echo_score = server.engine.score(tokens)
+
+                def build_choice(index, toks, logps):
+                    text = server._decode_text(toks)
+                    finish = ('length' if len(toks) >= max_new
+                              else 'stop')
+                    cut = _first_stop_match(text, stop)
                     if cut >= 0:
-                        kept, acc = [], 0
-                        for ts in token_strs:
-                            if acc >= len(text):
-                                break
-                            kept.append(ts[:len(text) - acc])
-                            acc += len(ts)
-                        token_strs = kept
-                        kept_lps = kept_lps[:len(kept)]
+                        text = text[:cut]
+                        finish = 'stop'
+                    logprobs_obj = None
+                    if want_logprobs:
+                        # A stop-sequence cut truncates the token list
+                        # to the kept text.
+                        token_strs = server._token_strs(toks)
+                        kept_lps = [round(p, 6) for p in logps]
+                        if cut >= 0:
+                            kept, acc = [], 0
+                            for ts in token_strs:
+                                if acc >= len(text):
+                                    break
+                                kept.append(ts[:len(text) - acc])
+                                acc += len(ts)
+                            token_strs = kept
+                            kept_lps = kept_lps[:len(kept)]
+                        if chat:
+                            # chat.completion logprobs schema.
+                            logprobs_obj = {'content': [
+                                {'token': ts, 'logprob': p}
+                                for ts, p in zip(token_strs, kept_lps)]}
+                        else:
+                            # Legacy text-completion logprobs schema.
+                            logprobs_obj = {
+                                'tokens': token_strs,
+                                'token_logprobs': kept_lps,
+                                'top_logprobs': None,
+                            }
+                    if not chat and req.get('echo'):
+                        # OpenAI echo semantics: the prompt is part of
+                        # the returned text (and of the logprobs
+                        # arrays, via the teacher-forced scoring pass).
+                        text = server._decode_text(tokens) + text
+                        if logprobs_obj is not None:
+                            p_lps, p_ids, p_tops = echo_score
+                            p_strs = server._token_strs(tokens)
+                            logprobs_obj = {
+                                'tokens':
+                                    p_strs + logprobs_obj['tokens'],
+                                'token_logprobs':
+                                    [None] + [round(p, 6)
+                                              for p in p_lps[1:]]
+                                    + logprobs_obj['token_logprobs'],
+                                'top_logprobs':
+                                    [None] + [
+                                        {server._decode_text([i]):
+                                         round(p, 6)}
+                                        for i, p in zip(p_ids[1:],
+                                                        p_tops[1:])]
+                                    + [None] * len(
+                                        logprobs_obj['tokens']),
+                            }
                     if chat:
-                        # chat.completion logprobs schema.
-                        logprobs_obj = {'content': [
-                            {'token': ts, 'logprob': p}
-                            for ts, p in zip(token_strs, kept_lps)]}
-                    else:
-                        # Legacy text-completion logprobs schema.
-                        logprobs_obj = {
-                            'tokens': token_strs,
-                            'token_logprobs': kept_lps,
-                            'top_logprobs': None,
-                        }
-                if not chat and req.get('echo'):
-                    # OpenAI echo semantics: the prompt is part of the
-                    # returned text (and of the logprobs arrays, via
-                    # the teacher-forced scoring pass).
-                    text = server._decode_text(tokens) + text
-                    if logprobs_obj is not None:
-                        p_lps, p_ids, p_tops = server.engine.score(
-                            tokens)
-                        p_strs = server._token_strs(tokens)
-                        logprobs_obj = {
-                            'tokens': p_strs + logprobs_obj['tokens'],
-                            'token_logprobs':
-                                [None] + [round(p, 6)
-                                          for p in p_lps[1:]]
-                                + logprobs_obj['token_logprobs'],
-                            'top_logprobs':
-                                [None] + [
-                                    {server._decode_text([i]):
-                                     round(p, 6)}
-                                    for i, p in zip(p_ids[1:],
-                                                    p_tops[1:])]
-                                + [None] * len(
-                                    logprobs_obj['tokens']),
-                        }
-                if chat:
-                    choice = {'index': 0,
-                              'message': {'role': 'assistant',
-                                          'content': text},
-                              'logprobs': logprobs_obj,
-                              'finish_reason': finish}
-                    obj = 'chat.completion'
-                else:
-                    choice = {'index': 0, 'text': text,
-                              'logprobs': logprobs_obj,
-                              'finish_reason': finish}
-                    obj = 'text_completion'
+                        return {'index': index,
+                                'message': {'role': 'assistant',
+                                            'content': text},
+                                'logprobs': logprobs_obj,
+                                'finish_reason': finish}
+                    return {'index': index, 'text': text,
+                            'logprobs': logprobs_obj,
+                            'finish_reason': finish}
+
+                # Rank by cumulative logprob (greedy duplicates tie —
+                # order then keeps arrival order, like OpenAI).
+                order = sorted(range(best_of),
+                               key=lambda i: -sum(results[i][1]))
+                choices = [build_choice(ci, results[i][0],
+                                        results[i][1])
+                           for ci, i in enumerate(order[:n])]
+                obj = 'chat.completion' if chat else 'text_completion'
+                gen_total = sum(len(t) for t, _l, _e in results)
                 self._json(200, {
                     'id': rid, 'object': obj, 'created': created,
-                    'model': server.model_name, 'choices': [choice],
+                    'model': server.model_name, 'choices': choices,
                     'usage': {'prompt_tokens': len(tokens),
-                              'completion_tokens': len(toks),
-                              'total_tokens': len(tokens) + len(toks)}})
+                              'completion_tokens': gen_total,
+                              'total_tokens': len(tokens) + gen_total}})
 
             def _score_prompt(self, req, tokens: List[int]) -> None:
                 """echo=true, max_tokens=0, logprobs: per-token

@@ -1,0 +1,103 @@
+"""OpenAI `n` / `best_of`: multiple completions per request, ranked by
+cumulative logprob, usage counting every generated token (the OpenAI
+best_of billing semantics)."""
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from skypilot_tpu.models import llama
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import engine_server
+
+
+@pytest.fixture(scope='module')
+def server():
+    eng = engine_lib.Engine(
+        llama.llama_tiny(), seed=3,
+        engine_cfg=engine_lib.EngineConfig(
+            batch_size=4, max_decode_len=128, prefill_buckets=(8, 64),
+            eos_id=-1))
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    srv = engine_server.ModelServer.from_engine(eng, port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    assert srv.ready.wait(timeout=120)
+    yield srv
+    srv.shutdown()
+
+
+def _post(srv, path, body, expect_error=False):
+    req = urllib.request.Request(
+        f'http://127.0.0.1:{srv.port}{path}',
+        data=json.dumps(body).encode(),
+        headers={'Content-Type': 'application/json'})
+    if expect_error:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=120)
+        return ei.value.code
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return json.loads(resp.read())
+
+
+def test_n_returns_that_many_choices(server):
+    out = _post(server, '/v1/completions',
+                {'model': 'model', 'prompt': [5, 9, 23],
+                 'max_tokens': 6, 'n': 3, 'temperature': 0.9})
+    assert [c['index'] for c in out['choices']] == [0, 1, 2]
+    # usage counts every generated token across the fan-out
+    assert out['usage']['completion_tokens'] == 18
+
+
+def test_best_of_ranks_by_cumulative_logprob(server):
+    out = _post(server, '/v1/completions',
+                {'model': 'model', 'prompt': [5, 9, 23],
+                 'max_tokens': 6, 'n': 2, 'best_of': 4,
+                 'temperature': 0.9, 'logprobs': 1})
+    assert len(out['choices']) == 2
+    sums = [sum(c['logprobs']['token_logprobs'])
+            for c in out['choices']]
+    assert sums[0] >= sums[1]          # ranked best-first
+    assert out['usage']['completion_tokens'] == 24   # 4 generations
+
+
+def test_greedy_n_identical(server):
+    out = _post(server, '/v1/completions',
+                {'model': 'model', 'prompt': [5, 9, 23],
+                 'max_tokens': 6, 'n': 2})
+    texts = [c['text'] for c in out['choices']]
+    assert texts[0] == texts[1]        # greedy: deterministic copies
+
+
+def test_chat_n(server):
+    out = _post(server, '/v1/chat/completions',
+                {'model': 'model',
+                 'messages': [{'role': 'user', 'content': 'hi'}],
+                 'max_tokens': 4, 'n': 2, 'temperature': 0.8})
+    assert len(out['choices']) == 2
+    assert all('message' in c for c in out['choices'])
+
+
+def test_invalid_combinations(server):
+    body = {'model': 'model', 'prompt': [5, 9], 'max_tokens': 2}
+    assert _post(server, '/v1/completions',
+                 {**body, 'n': 2, 'best_of': 1},
+                 expect_error=True) == 400
+    assert _post(server, '/v1/completions',
+                 {**body, 'n': 2, 'stream': True},
+                 expect_error=True) == 400
+    # best_of>1 with n=1 must ALSO reject under streaming (silently
+    # streaming one un-ranked completion would look like it worked).
+    assert _post(server, '/v1/completions',
+                 {**body, 'best_of': 4, 'stream': True},
+                 expect_error=True) == 400
+    assert _post(server, '/v1/completions',
+                 {**body, 'best_of': 40}, expect_error=True) == 400
+    assert _post(server, '/v1/chat/completions',
+                 {'model': 'model', 'max_tokens': 2, 'best_of': 2,
+                  'messages': [{'role': 'user', 'content': 'x'}]},
+                 expect_error=True) == 400
