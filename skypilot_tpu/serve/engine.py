@@ -145,6 +145,12 @@ class SamplingParams:
     # buffer so one SPMD program serves heterogeneous batches). A
     # tuple of (id, bias) pairs is accepted too.
     logit_bias: Any = None
+    # OpenAI seed: per-request sampling reproducibility. The request
+    # gets its own PRNG key (instead of one split from the engine's
+    # stream), and per-token noise is keyed on (key, position) alone —
+    # same seed + same prompt + same params => same tokens, regardless
+    # of what else shares the batch. None = engine-stream key.
+    seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -340,6 +346,10 @@ class Engine:
             self._bias_ids = jax.device_put(self._bias_ids, repl)
             self._bias_vals = jax.device_put(self._bias_vals, repl)
         self._key = jax.random.PRNGKey(seed + 1)
+        self._slot_keys = jax.random.split(
+            jax.random.PRNGKey(seed + 2), b)        # [B, 2] per-slot
+        if mesh is not None:
+            self._slot_keys = jax.device_put(self._slot_keys, repl)
         self._step_count = 0
         # Prefix-KV store: prompt token array -> dense kv sliced to the
         # prompt's true length. Insertion-ordered for LRU eviction.
@@ -377,11 +387,11 @@ class Engine:
         self._insert_jit = jax.jit(
             self._insert_impl, donate_argnums=(0, 10),
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
-                                repl, repl, repl, repl, repl))
+                                repl, repl, repl, repl, repl, repl))
         self._insert_many_jit = jax.jit(
             self._insert_many_impl, donate_argnums=(0, 10),
             out_shardings=out_s(cache_ns, repl, repl, repl, repl, repl,
-                                repl, repl, repl, repl, repl))
+                                repl, repl, repl, repl, repl, repl))
         self._decode_jit = jax.jit(
             functools.partial(self._decode_impl, cfg=model_cfg),
             static_argnames=('sampling_on', 'penalties_on',
@@ -428,6 +438,9 @@ class Engine:
                 raise ValueError(
                     f'{name} requires the model config to declare '
                     'vocab_size')
+        if sp.seed is not None and not 0 <= int(sp.seed) < 2 ** 32:
+            raise ValueError(
+                f'seed must be in [0, 2**32), got {sp.seed}')
         if sp.logit_bias:
             items = self._bias_items(sp)
             if len(items) > self._MAX_LOGIT_BIAS:
@@ -445,7 +458,8 @@ class Engine:
                         f'logit_bias value for token {tid} must be in '
                         f'[-100, 100], got {bias}')
 
-    def _sample(self, logits: jax.Array, key: jax.Array,
+    def _sample(self, logits: jax.Array, slot_keys: jax.Array,
+                positions: jax.Array,
                 temps: jax.Array, topks: jax.Array, topps: jax.Array,
                 sampling_on: bool, counts=None, freqs=None, press=None,
                 penalties_on: bool = False, bias_ids=None,
@@ -462,6 +476,15 @@ class Engine:
         throughput/default-server case — compile to a pure argmax
         program with no vocab-wide top_k/categorical and no [B, V]
         counts read at all.
+
+        Randomness is PER-SLOT: `slot_keys` [B, 2] (one PRNG key per
+        request, set at insert — from SamplingParams.seed when given)
+        folded with `positions` [B] (the token index being sampled),
+        drawn as per-row Gumbel noise (Gumbel-argmax == categorical
+        exactly). A request's sampled tokens therefore depend only on
+        (its key, its own position), never on batch composition — the
+        OpenAI `seed` reproducibility contract under continuous
+        batching.
 
         With penalties on, the selection distribution is
         logits - freqs*counts - press*(counts>0) (counts [B, V] =
@@ -513,8 +536,12 @@ class Engine:
         needs_filter = ((topks > 0) | (topps < 1.0))[:, None]
         final = jnp.where(needs_filter & (scaled < thresh),
                           -jnp.inf, scaled)
-        s = jax.random.categorical(key, final,
-                                   axis=-1).astype(jnp.int32)
+        row_keys = jax.vmap(jax.random.fold_in)(
+            slot_keys, positions.astype(jnp.uint32))
+        g = jax.vmap(
+            lambda kk_: jax.random.gumbel(kk_, final.shape[-1:],
+                                          jnp.float32))(row_keys)
+        s = jnp.argmax(final + g, axis=-1).astype(jnp.int32)
         chosen = jnp.where(temps <= 0, greedy, s)
         return chosen, logprob_of(chosen)
 
@@ -563,11 +590,16 @@ class Engine:
     def _prefill_impl(self, params, tokens, true_len, key, temp, topk,
                       topp, bias_ids, bias_vals, cfg, sampling_on,
                       biased_on):
-        """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..])."""
+        """tokens [1, S_bucket]; returns (first_token [], kv [L,1,S,..]).
+        The first token samples at position true_len (== the prompt
+        length) under the request key — the same (key, position) pair
+        every later decode step of this request keys on."""
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[0, true_len - 1]
-        toks, logps = self._sample(last[None], key, temp[None],
+        toks, logps = self._sample(last[None], key[None],
+                                   jnp.asarray(true_len)[None],
+                                   temp[None],
                                    topk[None], topp[None], sampling_on,
                                    bias_ids=bias_ids,
                                    bias_vals=bias_vals,
@@ -602,8 +634,9 @@ class Engine:
 
     def _insert_impl(self, cache, prefix_kv, slot, length, lengths, tokens,
                      first_token, temps, topks, topps, counts, freqs,
-                     press, bias_ids, bias_vals, temp, topk, topp,
-                     fpen, ppen, bias_ids_new, bias_vals_new):
+                     press, bias_ids, bias_vals, slot_keys, temp, topk,
+                     topp, fpen, ppen, bias_ids_new, bias_vals_new,
+                     key_new):
         """Copy prefix kv [L,1,S,KV,hd] into cache row `slot`. Penalty
         counts restart at the first generated token (output-only
         semantics)."""
@@ -624,8 +657,9 @@ class Engine:
         counts = counts.at[slot, first_token].add(1)
         bias_ids = bias_ids.at[slot].set(bias_ids_new)
         bias_vals = bias_vals.at[slot].set(bias_vals_new)
+        slot_keys = slot_keys.at[slot].set(key_new)
         return (new_cache, lengths, tokens, temps, topks, topps,
-                counts, freqs, press, bias_ids, bias_vals)
+                counts, freqs, press, bias_ids, bias_vals, slot_keys)
 
     def _extend_impl(self, params, prefix_k, prefix_v, tokens, true_len,
                      key, temp, topk, topp, bias_ids, bias_vals, cfg,
@@ -641,7 +675,12 @@ class Engine:
             params, tokens, cfg, positions=p + jnp.arange(s),
             return_kv=True, prefix={'k': prefix_k, 'v': prefix_v})
         last = logits[0, true_len - 1]
-        toks, logps = self._sample(last[None], key, temp[None],
+        # Position = full prompt length (prefix + suffix): a seeded
+        # request samples the same first token whether or not a
+        # prefix-store hit served part of its prefill.
+        toks, logps = self._sample(last[None], key[None],
+                                   jnp.asarray(p + true_len)[None],
+                                   temp[None],
                                    topk[None], topp[None], sampling_on,
                                    bias_ids=bias_ids,
                                    bias_vals=bias_vals,
@@ -714,7 +753,7 @@ class Engine:
                 '(and a model with prefix support)')
         self.prefill(list(tokens))
 
-    def _prefill_many_impl(self, params, tokens, true_lens, key,
+    def _prefill_many_impl(self, params, tokens, true_lens, keys,
                            temps, topks, topps, bias_ids, bias_vals,
                            cfg, sampling_on, biased_on):
         """tokens [N, S_bucket], true_lens [N]; one forward for N prompts.
@@ -725,7 +764,8 @@ class Engine:
         logits, kv = self.model.forward(params, tokens, cfg,
                                         return_kv=True)
         last = logits[jnp.arange(tokens.shape[0]), true_lens - 1]  # [N,V]
-        toks, logps = self._sample(last, key, temps, topks, topps,
+        toks, logps = self._sample(last, keys, true_lens, temps,
+                                   topks, topps,
                                    sampling_on, bias_ids=bias_ids,
                                    bias_vals=bias_vals,
                                    biased_on=biased_on)
@@ -734,9 +774,9 @@ class Engine:
     def _insert_many_impl(self, cache, prefix_kv, slots, lengths_new,
                           lengths, tokens, first_tokens, temps, topks,
                           topps, counts, freqs, press, bias_ids,
-                          bias_vals, temps_new, topks_new, topps_new,
-                          freqs_new, press_new, bias_ids_new,
-                          bias_vals_new):
+                          bias_vals, slot_keys, temps_new, topks_new,
+                          topps_new, freqs_new, press_new,
+                          bias_ids_new, bias_vals_new, keys_new):
         """Scatter prefix kv [L,N,S,KV,hd] into cache rows `slots` [N]
         (distinct), one device program for the whole wave. Penalty
         counts restart at the first generated token (output-only
@@ -757,16 +797,25 @@ class Engine:
         counts = counts.at[slots, first_tokens].add(1)
         bias_ids = bias_ids.at[slots].set(bias_ids_new)
         bias_vals = bias_vals.at[slots].set(bias_vals_new)
+        slot_keys = slot_keys.at[slots].set(keys_new)
         return (new_cache, lengths, tokens, temps, topks, topps,
-                counts, freqs, press, bias_ids, bias_vals)
+                counts, freqs, press, bias_ids, bias_vals, slot_keys)
 
-    def _decode_impl(self, params, cache, lengths, tokens, key, temps,
+    def _decode_impl(self, params, cache, lengths, tokens, slot_keys,
+                     temps,
                      topks, topps, counts, freqs, press, bias_ids,
                      bias_vals, cfg, sampling_on, penalties_on,
                      biased_on):
         logits, new_cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-        next_tokens, logps = self._sample(logits, key, temps, topks,
+        # Fold position = the index of the token being produced
+        # (lengths + 1): position `lengths` was already consumed by
+        # the prefill/extend sample of this request's first token —
+        # reusing it would replay that step's Gumbel noise and bias
+        # the second token into duplicating the first.
+        next_tokens, logps = self._sample(logits, slot_keys,
+                                          lengths + 1,
+                                          temps, topks,
                                           topps, sampling_on,
                                           counts=counts, freqs=freqs,
                                           press=press,
@@ -779,17 +828,21 @@ class Engine:
             counts = counts.at[rows, next_tokens].add(1)
         return next_tokens, logps, new_cache, lengths + 1, counts
 
-    def _decode_many_impl(self, params, cache, lengths, tokens, key,
+    def _decode_many_impl(self, params, cache, lengths, tokens,
+                          slot_keys,
                           temps, topks, topps, counts, freqs, press,
                           bias_ids, bias_vals, k, cfg, sampling_on,
                           penalties_on, biased_on):
         """k fused decode steps (lax.scan): returns ([k, B] tokens, ...).
-        One dispatch + one host transfer per k tokens."""
-        def body(carry, subkey):
+        One dispatch + one host transfer per k tokens. Per-step
+        randomness keys on (slot key, lengths) — lengths increments
+        each step, so no per-step key splitting is needed."""
+        def body(carry, _):
             cache, lengths, tokens, counts = carry
             logits, cache = self.model.decode_step(params, cache,
                                                    lengths, tokens, cfg)
-            nt, lp = self._sample(logits, subkey, temps, topks, topps,
+            nt, lp = self._sample(logits, slot_keys, lengths + 1,
+                                  temps, topks, topps,
                                   sampling_on, counts=counts,
                                   freqs=freqs, press=press,
                                   penalties_on=penalties_on,
@@ -801,9 +854,8 @@ class Engine:
                 counts = counts.at[rows, nt].add(1)
             return (cache, lengths + 1, nt, counts), (nt, lp)
 
-        keys = jax.random.split(key, k)
         (cache, lengths, tokens, counts), (toks, logps) = jax.lax.scan(
-            body, (cache, lengths, tokens, counts), keys)
+            body, (cache, lengths, tokens, counts), None, length=k)
         return toks, logps, cache, lengths, tokens, counts
 
     # -- host-side API --------------------------------------------------- #
@@ -870,6 +922,14 @@ class Engine:
     def _has_bias(sp: SamplingParams) -> bool:
         return bool(sp.logit_bias)
 
+    def _request_key(self, sp: SamplingParams):
+        """The request's PRNG key: its own (seed) or one split off
+        the engine stream."""
+        if sp.seed is not None:
+            return jax.random.PRNGKey(int(sp.seed))
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
     def _sampling_or_default(self, sampling) -> SamplingParams:
         if sampling is None:
             return SamplingParams(temperature=self.cfg.temperature)
@@ -881,7 +941,7 @@ class Engine:
         """Dispatch a single-prompt prefill WITHOUT host reads; returns
         device (token, logprob, kv). Routes through the extend path
         when `found` (or a fresh lookup) names a stored prefix."""
-        self._key, sub = jax.random.split(self._key)
+        sub = self._request_key(sp)
         if found is None:
             found = self._find_prefix(prompt)
         if found is not None:
@@ -956,7 +1016,7 @@ class Engine:
         start, n = state['done'], len(prompt)
         take = min(self.cfg.prefill_chunk, n - start)
         bucket = self._bucket(take)
-        self._key, sub = jax.random.split(self._key)
+        sub = self._request_key(sp)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :take] = prompt[start:start + take]
         bids, bvals = self._bias_row(sp)
@@ -1020,16 +1080,18 @@ class Engine:
         bids, bvals = self._bias_row(sp)
         (self._cache, self._lengths, self._tokens, self._temps,
          self._topks, self._topps, self._counts, self._freqs,
-         self._press, self._bias_ids, self._bias_vals) = \
+         self._press, self._bias_ids, self._bias_vals,
+         self._slot_keys) = \
             self._insert_jit(
             self._cache, prefix_kv, slot, length, self._lengths,
             self._tokens, first_token, self._temps, self._topks,
             self._topps, self._counts, self._freqs, self._press,
-            self._bias_ids, self._bias_vals,
+            self._bias_ids, self._bias_vals, self._slot_keys,
             jnp.float32(sp.temperature),
             jnp.int32(sp.top_k), jnp.float32(sp.top_p),
             jnp.float32(sp.frequency_penalty),
-            jnp.float32(sp.presence_penalty), bids, bvals)
+            jnp.float32(sp.presence_penalty), bids, bvals,
+            self._request_key(sp))
 
     # Cap on one batched-prefill dispatch: bounds the transient
     # [L, N, S, KV, hd] prefill-kv buffer and the number of distinct
@@ -1106,11 +1168,12 @@ class Engine:
                 bvals = np.stack([r[1] for r in brows])
                 chunk_biased = any(self._has_bias(sp)
                                    for _s, _p, sp in chunk)
-                self._key, sub = jax.random.split(self._key)
+                req_keys = jnp.stack([self._request_key(sp)
+                                      for _s, _p, sp in chunk])
                 toks, logps, kv = self._prefill_many_jit(
                     self.params, jnp.asarray(padded),
-                    jnp.asarray(true_lens), sub, temps, topks, topps,
-                    bids, bvals,
+                    jnp.asarray(true_lens), req_keys, temps, topks,
+                    topps, bids, bvals,
                     sampling_on=any(sp.temperature > 0
                                     for _s, _p, sp in chunk),
                     biased_on=chunk_biased)
@@ -1131,14 +1194,17 @@ class Engine:
                                           for _s, _p, sp in chunk]
                 (self._cache, self._lengths, self._tokens, self._temps,
                  self._topks, self._topps, self._counts, self._freqs,
-                 self._press, self._bias_ids, self._bias_vals) = \
+                 self._press, self._bias_ids, self._bias_vals,
+                 self._slot_keys) = \
                     self._insert_many_jit(
                     self._cache, kv, jnp.asarray(slots),
                     jnp.asarray(true_lens), self._lengths,
                     self._tokens, toks, self._temps, self._topks,
                     self._topps, self._counts, self._freqs,
                     self._press, self._bias_ids, self._bias_vals,
-                    temps, topks, topps, fpens, ppens, bids, bvals)
+                    self._slot_keys,
+                    temps, topks, topps, fpens, ppens, bids, bvals,
+                    req_keys)
                 if self._prefix_enabled():
                     # Batched prefills seed the store too — a burst's
                     # first wave makes every later request a hit.
@@ -1167,10 +1233,10 @@ class Engine:
         device computes step N+1 — through a remote-execution relay the
         read is a network round trip, which would otherwise serialize
         with every step)."""
-        self._key, sub = jax.random.split(self._key)
         (next_tokens, logps, self._cache, self._lengths,
          self._counts) = self._decode_jit(
-            self.params, self._cache, self._lengths, self._tokens, sub,
+            self.params, self._cache, self._lengths, self._tokens,
+            self._slot_keys,
             self._temps, self._topks, self._topps, self._counts,
             self._freqs, self._press, self._bias_ids, self._bias_vals,
             sampling_on=bool((self._host_temps > 0).any()),
@@ -1194,11 +1260,11 @@ class Engine:
         per-token latency path)."""
         if k <= 1:
             return self.decode_dispatch()
-        self._key, sub = jax.random.split(self._key)
         (toks, logps, self._cache, self._lengths, self._tokens,
          self._counts) = \
             self._decode_many_jit(self.params, self._cache,
-                                  self._lengths, self._tokens, sub,
+                                  self._lengths, self._tokens,
+                                  self._slot_keys,
                                   self._temps, self._topks, self._topps,
                                   self._counts, self._freqs,
                                   self._press, self._bias_ids,

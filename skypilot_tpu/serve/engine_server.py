@@ -239,7 +239,7 @@ class ModelServer:
         if not any(k in req for k in
                    ('temperature', 'top_k', 'top_p',
                     'frequency_penalty', 'presence_penalty',
-                    'logit_bias')):
+                    'logit_bias', 'seed')):
             return None
         # Unspecified fields keep the SERVER's defaults (a request
         # asking only for top_p must not silently flip the temperature
@@ -253,7 +253,9 @@ class ModelServer:
             presence_penalty=float(req.get('presence_penalty', 0.0)),
             # OpenAI sends {"<token id as string>": bias}; normalize
             # to int keys (validate_sampling checks range and count).
-            logit_bias=_parse_logit_bias(req.get('logit_bias')))
+            logit_bias=_parse_logit_bias(req.get('logit_bias')),
+            seed=(int(req['seed']) if req.get('seed') is not None
+                  else None))
         # Loud validation at the API boundary (engine re-validates):
         # silently clamping top_k>64 to 64 surprised clients.
         self.engine.validate_sampling(sp)
@@ -492,9 +494,20 @@ class ModelServer:
                             stream_opts.get('include_usage')))
                     return
                 # best_of - 1 extra parallel generations (queue 0 was
-                # enqueued above, before the stream branch).
-                extra_qs = [self._enqueue(tokens, max_new, sampling)
-                            for _ in range(best_of - 1)]
+                # enqueued above, before the stream branch). A seeded
+                # request gets seed+i per extra copy — byte-identical
+                # copies would make the logprob ranking (and the n>1
+                # diversity the client asked for) meaningless.
+                def copy_sampling(i):
+                    if (sampling is not None
+                            and sampling.seed is not None):
+                        import dataclasses as _dc
+                        return _dc.replace(sampling,
+                                           seed=sampling.seed + i)
+                    return sampling
+                extra_qs = [self._enqueue(tokens, max_new,
+                                          copy_sampling(i))
+                            for i in range(1, best_of)]
                 results = [self._collect(q)
                            for q in [out_q] + extra_qs]
                 for _t, _l, error in results:

@@ -452,7 +452,9 @@ def test_topp_mass_uses_full_distribution(model):
     logits = jnp.zeros((1, cfg.vocab_size))   # flat: every p = 1/128
     toks = set()
     for i in range(200):
-        t, _lp = eng._sample(logits, jax.random.PRNGKey(i),
+        t, _lp = eng._sample(logits,
+                             jax.random.PRNGKey(i)[None],
+                             jnp.asarray([0]),
                              jnp.asarray([1.0]), jnp.asarray([0]),
                              jnp.asarray([0.95]), sampling_on=True)
         toks.add(int(t[0]))

@@ -62,9 +62,12 @@ def _draw(eng, logits, sp: SamplingParams, n=N_SAMPLES) -> np.ndarray:
     temps = jnp.full((batch,), sp.temperature, jnp.float32)
     topks = jnp.full((batch,), sp.top_k, jnp.int32)
     topps = jnp.full((batch,), sp.top_p, jnp.float32)
-    sample = jax.jit(lambda key: eng._sample(
-        tiled, key, temps, topks, topps, sampling_on=True)[0])
-    out = [np.asarray(sample(jax.random.PRNGKey(1000 + i)))
+    positions = jnp.zeros((batch,), jnp.int32)
+    sample = jax.jit(lambda keys: eng._sample(
+        tiled, keys, positions, temps, topks, topps,
+        sampling_on=True)[0])
+    out = [np.asarray(sample(jax.random.split(
+               jax.random.PRNGKey(1000 + i), batch)))
            for i in range(reps)]
     return np.concatenate(out)[:n]
 
@@ -162,8 +165,10 @@ def test_greedy_rows_unaffected_by_sampling_rows(eng, logits):
     temps = jnp.asarray([0.0, 1.0] * 4, jnp.float32)
     topks = jnp.zeros((batch,), jnp.int32)
     topps = jnp.ones((batch,), jnp.float32)
-    out = np.asarray(eng._sample(tiled, jax.random.PRNGKey(0), temps,
-                                 topks, topps, sampling_on=True)[0])
+    out = np.asarray(eng._sample(
+        tiled, jax.random.split(jax.random.PRNGKey(0), batch),
+        jnp.zeros((batch,), jnp.int32), temps,
+        topks, topps, sampling_on=True)[0])
     argmax = int(np.argmax(np.asarray(logits)))
     assert all(out[i] == argmax for i in range(0, batch, 2))
 
