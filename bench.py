@@ -505,19 +505,6 @@ def main() -> None:
     import jax
     from skypilot_tpu.models import llama
 
-    # Persistent XLA compilation cache: the serving rows compile
-    # 32-unrolled-layer decode programs, and through the axon tunnel's
-    # remote-compile service a cold 8B compile is minutes. The cache
-    # is keyed on HLO, so any prior run of this script (or the profile
-    # scripts, which set the same dir) warms it for the next.
-    try:
-        jax.config.update('jax_compilation_cache_dir',
-                          '/tmp/skyt_jax_cache')
-        jax.config.update('jax_persistent_cache_min_compile_time_secs',
-                          2.0)
-    except Exception:  # noqa: BLE001 — older jax: cache is best-effort
-        pass
-
     # Honor JAX_PLATFORMS=cpu even under the axon TPU tunnel, whose
     # plugin self-registers regardless of the env var (same pin as
     # tests/conftest.py) — a CPU bench run must not touch the tunnel.
@@ -533,6 +520,22 @@ def main() -> None:
 
     device = jax.devices()[0]
     on_tpu = device.platform != 'cpu'
+
+    if on_tpu:
+        # Persistent XLA compilation cache, TPU runs only (CPU AOT
+        # cache entries carry host-machine-feature assumptions — a
+        # mismatched load warns about possible SIGILL). The serving
+        # rows compile 32-unrolled-layer decode programs, and through
+        # the axon tunnel's remote-compile service a cold 8B compile
+        # is minutes; the cache is keyed on HLO, so any prior run of
+        # this script (or the profile scripts) warms the next.
+        try:
+            jax.config.update('jax_compilation_cache_dir',
+                              '/tmp/skyt_jax_cache')
+            jax.config.update(
+                'jax_persistent_cache_min_compile_time_secs', 2.0)
+        except Exception:  # noqa: BLE001 — best-effort on older jax
+            pass
 
     if on_tpu:
         # ~500M params: fits one v5e chip (16 GB) with fp32 adam moments.

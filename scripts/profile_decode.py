@@ -37,7 +37,9 @@ def main() -> None:
 
     import jax
 
-    try:   # share bench.py's persistent compile cache (8B: minutes)
+    try:   # share bench.py's persistent compile cache (8B: minutes);
+        # this script asserts a TPU device below, so no CPU AOT
+        # entries can be written.
         jax.config.update('jax_compilation_cache_dir',
                           '/tmp/skyt_jax_cache')
         jax.config.update('jax_persistent_cache_min_compile_time_secs',
