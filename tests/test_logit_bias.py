@@ -23,10 +23,16 @@ def _engine(**kw):
 PROMPT = [5, 9, 23]   # greedy: 267, 267, 398, ...
 
 
-def test_force_and_ban_tokens():
+@pytest.fixture(scope='module')
+def eng():
+    """Shared default-config engine (insert rewrites per-slot state,
+    so tests are isolated)."""
+    return _engine()
+
+
+def test_force_and_ban_tokens(eng):
     """+100 forces a token everywhere (greedy argmax over biased
     logits); -100 on the natural first choice bans it."""
-    eng = _engine()
     base = eng.generate_batch([PROMPT], max_new_tokens=8)[0]
     forced = eng.generate_batch(
         [PROMPT], max_new_tokens=8,
@@ -39,8 +45,7 @@ def test_force_and_ban_tokens():
     assert base[0] not in banned
 
 
-def test_no_bias_identical_and_mixed_batch():
-    eng = _engine()
+def test_no_bias_identical_and_mixed_batch(eng):
     solo = eng.generate_batch([PROMPT], max_new_tokens=8)[0]
     outs = eng.generate_batch(
         [PROMPT, PROMPT], max_new_tokens=8,
@@ -60,8 +65,7 @@ def test_bias_cleared_on_slot_reuse():
     assert after == base
 
 
-def test_validation():
-    eng = _engine()
+def test_validation(eng):
     with pytest.raises(ValueError, match='at most'):
         eng.validate_sampling(SamplingParams(
             logit_bias={i: 1.0 for i in range(65)}))
@@ -71,10 +75,9 @@ def test_validation():
         eng.validate_sampling(SamplingParams(logit_bias={7: 200.0}))
 
 
-def test_duplicate_ids_last_wins():
+def test_duplicate_ids_last_wins(eng):
     """Tuple-of-pairs input with duplicate ids must not stack past the
     validated range — last entry wins (dict semantics)."""
-    eng = _engine()
     sp = SamplingParams(logit_bias=((7, 80.0), (7, 80.0)))
     eng.validate_sampling(sp)
     assert eng._bias_items(sp) == {7: 80.0}

@@ -28,6 +28,13 @@ PROMPT = [5, 9, 23]
 SP = dict(temperature=0.9, top_p=0.95)
 
 
+@pytest.fixture(scope='module')
+def eng():
+    """Shared default-config engine (insert rewrites per-slot state,
+    so tests are isolated)."""
+    return _engine()
+
+
 def test_same_seed_independent_of_engine_stream_state():
     """A seeded request's output must not depend on how much of the
     engine's own RNG stream was consumed before it arrived (same
@@ -60,8 +67,7 @@ def test_seed_independent_of_batch_composition():
     assert outs[1] == solo
 
 
-def test_different_seeds_differ():
-    eng = _engine()
+def test_different_seeds_differ(eng):
     a = eng.generate_batch([PROMPT], max_new_tokens=16,
                            sampling=SamplingParams(seed=1, **SP))[0]
     b = eng.generate_batch([PROMPT], max_new_tokens=16,
@@ -69,9 +75,8 @@ def test_different_seeds_differ():
     assert a != b
 
 
-def test_unseeded_requests_independent():
+def test_unseeded_requests_independent(eng):
     """Two unseeded sampled requests in one batch draw independently."""
-    eng = _engine()
     outs = eng.generate_batch([PROMPT, PROMPT], max_new_tokens=16,
                               sampling=SamplingParams(**SP))
     assert outs[0] != outs[1]
@@ -94,12 +99,11 @@ def test_seed_reproducible_through_prefix_cache():
     assert warm == cold
 
 
-def test_first_two_tokens_use_independent_noise():
+def test_first_two_tokens_use_independent_noise(eng):
     """Regression: the first decode step must not fold the same
     (key, position) the prefill sample used — that replays the
     prefill's Gumbel noise and makes token2 duplicate token1 almost
     surely at high temperature."""
-    eng = _engine()
     dup = 0
     n = 20
     for i in range(n):
@@ -113,15 +117,14 @@ def test_first_two_tokens_use_independent_noise():
     assert dup <= n // 3, f'{dup}/{n} duplicated first tokens'
 
 
-def test_seed_range_validated():
-    eng = _engine()
+def test_seed_range_validated(eng):
     with pytest.raises(ValueError, match='seed'):
         eng.validate_sampling(SamplingParams(seed=2 ** 63))
     with pytest.raises(ValueError, match='seed'):
         eng.validate_sampling(SamplingParams(seed=-1))
 
 
-def test_n_with_seed_gives_distinct_choices():
+def test_n_with_seed_gives_distinct_choices(eng):
     """Server fan-out: a seeded n>1 request derives seed+i per copy —
     identical choices would defeat both diversity and ranking."""
     import json
@@ -130,7 +133,6 @@ def test_n_with_seed_gives_distinct_choices():
 
     from skypilot_tpu.serve import engine_server
 
-    eng = _engine()
     with socket.socket() as s:
         s.bind(('127.0.0.1', 0))
         port = s.getsockname()[1]
